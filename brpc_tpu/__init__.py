@@ -1,0 +1,22 @@
+"""brpc_tpu — a TPU-native RPC/collective framework with bRPC's capabilities.
+
+Rebuild of Apache bRPC (reference: /root/reference, v1.15.0) designed
+TPU-first: the control plane is a Channel/Server/Controller RPC engine over
+TCP bootstrap sockets; the data plane rides PJRT host<->HBM transfers and XLA
+collectives over ICI/DCN (`brpc_tpu.tpu`). Combo channels (Parallel/
+Partition/Selective) lower onto mesh-axis collectives via shard_map.
+
+Layers (mirrors SURVEY.md §1):
+  butil/   — IOBuf, EndPoint (incl tpu://), versioned pools, DoublyBuffered
+  fiber/   — task runtime: execution queues, timers, versioned call ids
+  metrics/ — bvar equivalent: contention-free counters, windows, percentiles
+  rpc/     — Socket, EventDispatcher, InputMessenger, Channel/Server/Controller,
+             Stream, ParallelChannel/PartitionChannel/SelectiveChannel
+  policy/  — protocols, load balancers, naming services, limiters
+  tpu/     — TpuSocket, mesh naming, collective lowering, ring primitives
+  builtin/ — observability HTTP services (/status /vars /flags /rpcz ...)
+  trace/   — span/rpcz, rpc_dump/replay
+  native/  — C++ core (event loop, framing, crc32c) via ctypes
+"""
+
+__version__ = "0.1.0"

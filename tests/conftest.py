@@ -1,0 +1,18 @@
+"""Test substrate: a virtual 8-device CPU mesh (SURVEY §4 takeaway).
+
+The reference tests simulate a cluster with N channels to loopback servers;
+we likewise simulate a TPU pod with 8 virtual CPU devices via
+--xla_force_host_platform_device_count, set before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,241 @@
+"""Unit tests for butil (pattern: reference test/iobuf_unittest.cpp,
+test/endpoint_unittest.cpp, test/resource_pool_unittest.cpp)."""
+
+import threading
+
+import pytest
+
+from brpc_tpu.butil import (
+    IOBuf,
+    IOBufAppender,
+    EndPoint,
+    EndPointError,
+    VersionedPool,
+    DoublyBufferedData,
+    crc32c,
+    id_version,
+)
+
+
+class TestIOBuf:
+    def test_append_and_size(self):
+        buf = IOBuf()
+        assert buf.empty()
+        buf.append(b"hello")
+        buf.append(b" world")
+        assert len(buf) == 11
+        assert buf.tobytes() == b"hello world"
+
+    def test_cutn_zero_copy_split(self):
+        buf = IOBuf(b"abcdefgh")
+        head = buf.cutn(3)
+        assert head.tobytes() == b"abc"
+        assert buf.tobytes() == b"defgh"
+        assert len(buf) == 5
+
+    def test_cutn_across_blocks(self):
+        buf = IOBuf()
+        buf.append(b"aa")
+        buf.append(b"bb")
+        buf.append(b"cc")
+        head = buf.cutn(3)
+        assert head.tobytes() == b"aabb"[:3] + b""
+        assert head.tobytes() == b"aab"
+        assert buf.tobytes() == b"bcc"
+
+    def test_cutn_more_than_size(self):
+        buf = IOBuf(b"xy")
+        head = buf.cutn(10)
+        assert head.tobytes() == b"xy"
+        assert buf.empty()
+
+    def test_fetch_does_not_consume(self):
+        buf = IOBuf()
+        buf.append(b"ab")
+        buf.append(b"cd")
+        assert buf.fetch(3) == b"abc"
+        assert len(buf) == 4
+
+    def test_pop_front(self):
+        buf = IOBuf(b"0123456789")
+        buf.pop_front(4)
+        assert buf.tobytes() == b"456789"
+
+    def test_append_steals_iobuf(self):
+        a = IOBuf(b"aa")
+        b = IOBuf(b"bb")
+        a.append(b)
+        assert a.tobytes() == b"aabb"
+        assert b.empty()
+
+    def test_append_memoryview_no_copy(self):
+        backing = bytearray(b"zzzz")
+        buf = IOBuf()
+        buf.append_user_data(memoryview(bytes(backing)))
+        assert buf.tobytes() == b"zzzz"
+
+    def test_cut_into_writer_partial(self):
+        buf = IOBuf()
+        buf.append(b"a" * 100)
+        buf.append(b"b" * 100)
+        sink = []
+
+        def write_fn(mv):
+            take = min(len(mv), 30)
+            sink.append(bytes(mv[:take]))
+            return take
+
+        n = buf.cut_into_writer(write_fn)
+        # first block: 30-byte short write stops the loop
+        assert n == 30
+        assert len(buf) == 170
+
+    def test_appender_batches(self):
+        app = IOBufAppender()
+        for i in range(1000):
+            app.append(b"x")
+        buf = app.buf()
+        assert len(buf) == 1000
+        assert buf.block_count() < 10
+
+    def test_readinto(self):
+        buf = IOBuf()
+        buf.append(b"abc")
+        buf.append(b"def")
+        out = bytearray(6)
+        assert buf.readinto(out) == 6
+        assert bytes(out) == b"abcdef"
+
+
+class TestEndPoint:
+    def test_parse_ip(self):
+        ep = EndPoint.parse("127.0.0.1:8787")
+        assert ep.kind == "ip" and ep.host == "127.0.0.1" and ep.port == 8787
+        assert str(ep) == "127.0.0.1:8787"
+
+    def test_parse_hostname(self):
+        ep = EndPoint.parse("localhost:80")
+        assert ep.host == "localhost" and ep.port == 80
+
+    def test_parse_unix(self):
+        ep = EndPoint.parse("unix:/tmp/sock")
+        assert ep.kind == "unix" and ep.path == "/tmp/sock"
+
+    def test_parse_tpu(self):
+        ep = EndPoint.parse("tpu://hostA:9000/3")
+        assert ep.kind == "tpu"
+        assert ep.host == "hostA" and ep.port == 9000 and ep.device_ordinal == 3
+        assert str(ep) == "tpu://hostA:9000/3"
+
+    def test_parse_tpu_default_ordinal(self):
+        ep = EndPoint.parse("tpu://hostA")
+        assert ep.device_ordinal == 0
+
+    def test_parse_errors(self):
+        with pytest.raises(EndPointError):
+            EndPoint.parse("no-port-here")
+        with pytest.raises(EndPointError):
+            EndPoint.parse("tpu://h/xx")
+
+    def test_hashable(self):
+        a = EndPoint.parse("1.2.3.4:5")
+        b = EndPoint.parse("1.2.3.4:5")
+        assert a == b and hash(a) == hash(b)
+
+
+class TestVersionedPool:
+    def test_insert_address_remove(self):
+        pool = VersionedPool()
+        vid = pool.insert("obj")
+        assert pool.address(vid) == "obj"
+        assert pool.remove(vid) == "obj"
+        assert pool.address(vid) is None
+
+    def test_stale_id_after_reuse(self):
+        pool = VersionedPool()
+        vid1 = pool.insert("a")
+        pool.remove(vid1)
+        vid2 = pool.insert("b")
+        # slot reused, version bumped: old id must not resolve
+        assert pool.address(vid1) is None
+        assert pool.address(vid2) == "b"
+        assert id_version(vid2) == id_version(vid1) + 2
+
+    def test_live_objects(self):
+        pool = VersionedPool()
+        ids = [pool.insert(i) for i in range(5)]
+        pool.remove(ids[2])
+        assert sorted(pool.live_objects()) == [0, 1, 3, 4]
+        assert len(pool) == 4
+
+    def test_concurrent_insert_remove(self):
+        pool = VersionedPool()
+        errors = []
+
+        def worker():
+            try:
+                for _ in range(500):
+                    vid = pool.insert(object())
+                    assert pool.address(vid) is not None
+                    assert pool.remove(vid) is not None
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert len(pool) == 0
+
+
+class TestDoublyBuffered:
+    def test_read_sees_modify(self):
+        data = DoublyBufferedData(list)
+        data.modify(lambda lst: lst.append("s1"))
+        with data.read() as lst:
+            assert lst == ["s1"]
+
+    def test_both_buffers_converge(self):
+        data = DoublyBufferedData(list)
+        data.modify(lambda lst: lst.append(1))
+        data.modify(lambda lst: lst.append(2))
+        with data.read() as lst:
+            assert lst == [1, 2]
+        assert data._bufs[0] == data._bufs[1]
+
+    def test_concurrent_readers_and_modifier(self):
+        data = DoublyBufferedData(list)
+        stop = threading.Event()
+        errors = []
+
+        def reader():
+            while not stop.is_set():
+                with data.read() as lst:
+                    copy = list(lst)
+                    # list must always be a prefix-consistent snapshot
+                    if copy != sorted(copy):
+                        errors.append(copy)
+
+        threads = [threading.Thread(target=reader) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for i in range(100):
+            data.modify(lambda lst, i=i: lst.append(i))
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors
+
+
+class TestCrc32c:
+    def test_known_vector(self):
+        # standard CRC32-C test vector
+        assert crc32c(b"123456789") == 0xE3069283
+
+    def test_empty(self):
+        assert crc32c(b"") == 0
+
+    def test_chaining_differs_by_input(self):
+        assert crc32c(b"abc") != crc32c(b"abd")
