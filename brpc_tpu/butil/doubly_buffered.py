@@ -37,10 +37,6 @@ class DoublyBufferedData(Generic[T]):
         """
         return _ScopedRead(self)
 
-    def read_copy(self) -> T:
-        """Grab the foreground value without pinning (for immutable values)."""
-        return self._bufs[self._fg]
-
     # ---------------------------------------------------------------- modify
     def modify(self, fn: Callable[[T], object]) -> object:
         """Apply fn to both buffers with the foreground swapped in between.

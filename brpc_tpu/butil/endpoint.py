@@ -62,11 +62,16 @@ class EndPoint:
             return EndPoint.from_unix(text[len("unix:"):])
         if text.startswith("tpu://"):
             rest = text[len("tpu://"):]
-            # tpu://host[:port]/ordinal  or  tpu://host[:port] (ordinal 0)
+            # tpu://host[:port]/ordinal | tpu://host[:port] (ordinal 0)
+            # | tpu://mesh/<axis-name>  (collective target: a whole mesh axis)
             if "/" in rest:
                 hostpart, _, ordpart = rest.partition("/")
             else:
                 hostpart, ordpart = rest, "0"
+            if hostpart == "mesh":
+                if not ordpart:
+                    raise EndPointError(f"missing mesh axis in {text!r}")
+                return EndPoint(kind="tpu", host="mesh", mesh_axis=ordpart)
             host, port = EndPoint._split_hostport(hostpart, default_port=0)
             if not host:
                 raise EndPointError(f"missing host in tpu endpoint {text!r}")
@@ -87,8 +92,15 @@ class EndPoint:
             host = m.group("host")
             if host.startswith("["):
                 host = host[1:-1]
-            return host, int(m.group("port"))
-        if default_port is None and ":" in text:
+            port = int(m.group("port"))
+            if port > 65535:
+                raise EndPointError(f"port out of range in {text!r}")
+            return host, port
+        if text.startswith("[") and text.endswith("]"):
+            return text[1:-1], default_port  # bare bracketed ipv6
+        if ":" in text:
+            # has a colon but didn't match host:port -> malformed, never
+            # fold junk into the hostname
             raise EndPointError(f"cannot parse endpoint {text!r}")
         return text, default_port
 
@@ -111,6 +123,8 @@ class EndPoint:
             return f"{host}:{self.port}"
         if self.kind == "unix":
             return f"unix:{self.path}"
+        if self.mesh_axis:
+            return f"tpu://mesh/{self.mesh_axis}"
         hostpart = self.host if not self.port else f"{self.host}:{self.port}"
         return f"tpu://{hostpart}/{self.device_ordinal}"
 

@@ -48,6 +48,10 @@ class IOBuf:
     def append(self, data) -> None:
         """Append bytes-like or another IOBuf (steals its refs — O(blocks))."""
         if isinstance(data, IOBuf):
+            if data is self:
+                # self-append duplicates content instead of losing it
+                self.append(self.tobytes())
+                return
             self._refs.extend(data._refs)
             self._size += data._size
             data._refs = deque()

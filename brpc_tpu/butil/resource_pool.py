@@ -72,9 +72,13 @@ class VersionedPool(Generic[T]):
         if slot_idx >= len(slots):
             return None
         slot = slots[slot_idx]
+        # Read obj BEFORE version: if a concurrent remove+insert reincarnates
+        # the slot between the two reads, the version check fails and we
+        # return None instead of handing a stale id the new object.
+        obj = slot.obj
         if slot.version != id_version(vid):
             return None
-        return slot.obj
+        return obj
 
     def remove(self, vid: int) -> Optional[T]:
         """Free the slot; returns the object if the id was still live."""

@@ -1,0 +1,48 @@
+"""metrics — bvar equivalent: contention-free instrumentation (SURVEY §2.3)."""
+
+from brpc_tpu.metrics.variable import (
+    Variable,
+    describe_exposed,
+    get_exposed,
+    list_exposed,
+    dump_exposed,
+    clear_registry,
+)
+from brpc_tpu.metrics.reducer import Reducer, Adder, Maxer, Miner
+from brpc_tpu.metrics.percentile import Percentile, PercentileSamples
+from brpc_tpu.metrics.sampler import Sampler, SamplerCollector, global_collector
+from brpc_tpu.metrics.window import Window, PerSecond, WindowedPercentile
+from brpc_tpu.metrics.latency_recorder import IntRecorder, LatencyRecorder
+from brpc_tpu.metrics.status import (
+    Status,
+    PassiveStatus,
+    MultiDimension,
+    prometheus_text,
+)
+
+__all__ = [
+    "Variable",
+    "describe_exposed",
+    "get_exposed",
+    "list_exposed",
+    "dump_exposed",
+    "clear_registry",
+    "Reducer",
+    "Adder",
+    "Maxer",
+    "Miner",
+    "Percentile",
+    "PercentileSamples",
+    "Sampler",
+    "SamplerCollector",
+    "global_collector",
+    "Window",
+    "PerSecond",
+    "WindowedPercentile",
+    "IntRecorder",
+    "LatencyRecorder",
+    "Status",
+    "PassiveStatus",
+    "MultiDimension",
+    "prometheus_text",
+]

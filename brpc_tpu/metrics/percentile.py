@@ -1,0 +1,106 @@
+"""Percentile — per-thread reservoir sampling merged on read.
+
+Rebuild of ``bvar/detail/percentile.h:52,280,507``: writers add latencies to a
+thread-local reservoir (bounded, count-weighted); readers merge all thread
+reservoirs into one ``PercentileSamples`` and interpolate percentiles. Writes
+stay contention-free; accuracy degrades gracefully under load exactly like
+the reference (reservoir replacement is probabilistic once full).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import List
+
+SAMPLE_CAPACITY = 1024  # per-thread reservoir size
+
+
+class PercentileSamples:
+    """A merged, count-weighted sample set."""
+
+    __slots__ = ("samples", "count")
+
+    def __init__(self):
+        self.samples: List[float] = []
+        self.count = 0
+
+    def merge(self, other: "PercentileSamples") -> None:
+        self.samples.extend(other.samples)
+        self.count += other.count
+
+    def get_number(self, ratio: float) -> float:
+        """Value at the given ratio in [0,1] (e.g. 0.99 -> p99)."""
+        if not self.samples:
+            return 0.0
+        s = sorted(self.samples)
+        idx = min(int(ratio * len(s)), len(s) - 1)
+        return s[idx]
+
+
+class _ThreadReservoir:
+    __slots__ = ("samples", "count", "rng")
+
+    def __init__(self):
+        self.samples: List[float] = []
+        self.count = 0
+        self.rng = random.Random()
+
+    def add(self, value: float) -> None:
+        self.count += 1
+        if len(self.samples) < SAMPLE_CAPACITY:
+            self.samples.append(value)
+        else:
+            # classic reservoir replacement keeps a uniform sample
+            j = self.rng.randrange(self.count)
+            if j < SAMPLE_CAPACITY:
+                self.samples[j] = value
+
+    def take(self) -> PercentileSamples:
+        out = PercentileSamples()
+        out.samples = self.samples
+        out.count = self.count
+        self.samples = []
+        self.count = 0
+        return out
+
+
+class Percentile:
+    """Contention-free percentile collector."""
+
+    def __init__(self):
+        self._tls = threading.local()
+        self._reservoirs: List[_ThreadReservoir] = []
+        self._lock = threading.Lock()
+        # samples harvested by reset() (window sampler path)
+        self._harvested = PercentileSamples()
+
+    def put(self, value: float) -> None:
+        res = getattr(self._tls, "res", None)
+        if res is None:
+            res = _ThreadReservoir()
+            self._tls.res = res
+            with self._lock:
+                self._reservoirs.append(res)
+        res.add(value)
+
+    __lshift__ = put
+
+    def get_value(self) -> PercentileSamples:
+        """Merge current thread reservoirs (non-destructive snapshot)."""
+        out = PercentileSamples()
+        with self._lock:
+            for res in self._reservoirs:
+                snap = PercentileSamples()
+                snap.samples = list(res.samples)
+                snap.count = res.count
+                out.merge(snap)
+        return out
+
+    def reset(self) -> PercentileSamples:
+        """Harvest and clear all reservoirs (the per-second sampler path)."""
+        out = PercentileSamples()
+        with self._lock:
+            for res in self._reservoirs:
+                out.merge(res.take())
+        return out

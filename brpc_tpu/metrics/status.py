@@ -1,0 +1,84 @@
+"""Status / PassiveStatus / MultiDimension / prometheus exposition.
+
+Rebuilds bvar's gauge family: Status (set-once-read-many gauge,
+``bvar/status.h``), PassiveStatus (callback-backed gauge,
+``passive_status.h:42``), MultiDimension (labeled metrics,
+``multi_dimension.h``), and the Prometheus text format exporter
+(``builtin/prometheus_metrics_service.cpp:224``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Tuple
+
+from brpc_tpu.metrics.variable import Variable, dump_exposed
+
+
+class Status(Variable):
+    """A plain settable gauge."""
+
+    def __init__(self, value=0):
+        super().__init__()
+        self._value = value
+
+    def set_value(self, value) -> None:
+        self._value = value
+
+    def get_value(self):
+        return self._value
+
+
+class PassiveStatus(Variable):
+    """Gauge computed by a callback at read time."""
+
+    def __init__(self, fn: Callable[[], object]):
+        super().__init__()
+        self._fn = fn
+
+    def get_value(self):
+        return self._fn()
+
+
+class MultiDimension(Variable):
+    """Labeled metric family: get_stats(labels) -> per-combination variable."""
+
+    def __init__(self, label_names: Tuple[str, ...], factory=None):
+        super().__init__()
+        self.label_names = tuple(label_names)
+        self._factory = factory or (lambda: Status(0))
+        self._stats: Dict[Tuple[str, ...], Variable] = {}
+        self._lock = threading.Lock()
+
+    def get_stats(self, labels: Tuple[str, ...]) -> Variable:
+        labels = tuple(labels)
+        if len(labels) != len(self.label_names):
+            raise ValueError("label arity mismatch")
+        with self._lock:
+            var = self._stats.get(labels)
+            if var is None:
+                var = self._factory()
+                self._stats[labels] = var
+            return var
+
+    def get_value(self):
+        with self._lock:
+            return {k: v.get_value() for k, v in self._stats.items()}
+
+    def count_stats(self) -> int:
+        with self._lock:
+            return len(self._stats)
+
+
+def prometheus_text() -> str:
+    """Render every exposed variable in Prometheus exposition format."""
+    lines = []
+    for name, value in dump_exposed().items():
+        metric = name.replace(".", "_").replace("-", "_")
+        try:
+            num = float(value)
+        except (TypeError, ValueError):
+            continue  # prometheus only carries numeric samples
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {num:g}")
+    return "\n".join(lines) + ("\n" if lines else "")

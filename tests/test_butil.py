@@ -61,6 +61,11 @@ class TestIOBuf:
         buf.pop_front(4)
         assert buf.tobytes() == b"456789"
 
+    def test_self_append_duplicates(self):
+        a = IOBuf(b"ab")
+        a.append(a)
+        assert a.tobytes() == b"abab"
+
     def test_append_steals_iobuf(self):
         a = IOBuf(b"aa")
         b = IOBuf(b"bb")
@@ -136,6 +141,17 @@ class TestEndPoint:
             EndPoint.parse("no-port-here")
         with pytest.raises(EndPointError):
             EndPoint.parse("tpu://h/xx")
+        with pytest.raises(EndPointError):
+            EndPoint.parse("tpu://")  # empty host
+        with pytest.raises(EndPointError):
+            EndPoint.parse("tpu://h:bad/1")  # malformed port, not host junk
+        with pytest.raises(EndPointError):
+            EndPoint.parse("1.2.3.4:99999")  # port out of range
+
+    def test_parse_mesh_axis(self):
+        ep = EndPoint.parse("tpu://mesh/tensor")
+        assert ep.kind == "tpu" and ep.mesh_axis == "tensor"
+        assert str(ep) == "tpu://mesh/tensor"
 
     def test_hashable(self):
         a = EndPoint.parse("1.2.3.4:5")

@@ -1,0 +1,195 @@
+"""Metrics tests (pattern: reference test/bvar_*_unittest.cpp — real threads
+hammering reducers, manual sampler ticks instead of 1 s sleeps)."""
+
+import threading
+
+import pytest
+
+from brpc_tpu.metrics import (
+    Adder,
+    Maxer,
+    Miner,
+    IntRecorder,
+    LatencyRecorder,
+    Percentile,
+    PerSecond,
+    SamplerCollector,
+    Status,
+    PassiveStatus,
+    MultiDimension,
+    Window,
+    clear_registry,
+    dump_exposed,
+    get_exposed,
+    prometheus_text,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    clear_registry()
+    yield
+    clear_registry()
+
+
+class TestReducers:
+    def test_adder_single_thread(self):
+        a = Adder()
+        a << 1 << 2 << 3
+        assert a.get_value() == 6
+
+    def test_adder_many_threads(self):
+        a = Adder()
+        n_threads, per_thread = 8, 10_000
+
+        def worker():
+            for _ in range(per_thread):
+                a.put(1)
+
+        ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert a.get_value() == n_threads * per_thread
+
+    def test_maxer_miner(self):
+        m, mi = Maxer(), Miner()
+        for v in [3, 9, 1]:
+            m.put(v)
+            mi.put(v)
+        assert m.get_value() == 9
+        assert mi.get_value() == 1
+
+    def test_reset_zeroes(self):
+        a = Adder()
+        a.put(5)
+        assert a.reset() == 5
+        assert a.get_value() == 0
+
+
+class TestWindow:
+    def test_window_delta_partial_series(self):
+        col = SamplerCollector(interval_s=3600)  # never auto-ticks in test
+        a = Adder()
+        w = Window(a, window_size=3, collector=col)
+        a.put(10)
+        col.tick_all()  # sample: 10
+        a.put(5)
+        col.tick_all()  # sample: 15
+        # series started inside the window: everything counts
+        assert w.get_value() == 15
+
+    def test_window_delta_full_ring(self):
+        col = SamplerCollector(interval_s=3600)
+        a = Adder()
+        w = Window(a, window_size=2, collector=col)
+        for v in (10, 5, 2):
+            a.put(v)
+            col.tick_all()  # cumulative samples: 10, 15, 17
+        # last 2 seconds saw +5 and +2
+        assert w.get_value() == 7
+
+    def test_per_second(self):
+        col = SamplerCollector(interval_s=3600)
+        a = Adder()
+        qps = PerSecond(a, window_size=10, collector=col)
+        for _ in range(3):
+            a.put(100)
+            col.tick_all()
+        assert qps.get_value() == pytest.approx(100, rel=0.5)
+
+
+class TestPercentile:
+    def test_basic_distribution(self):
+        p = Percentile()
+        for i in range(1000):
+            p.put(i)
+        samples = p.get_value()
+        assert samples.count == 1000
+        assert 450 <= samples.get_number(0.5) <= 550
+        assert samples.get_number(0.99) >= 900
+
+    def test_multithread_counts(self):
+        p = Percentile()
+
+        def worker():
+            for i in range(5000):
+                p.put(i)
+
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert p.get_value().count == 20_000
+
+
+class TestLatencyRecorder:
+    def test_bundle(self):
+        col = SamplerCollector(interval_s=3600)
+        rec = LatencyRecorder(window_size=10, collector=col)
+        for v in range(1, 101):
+            rec.record(v * 10.0)
+        col.tick_all()
+        assert rec.count() == 100
+        assert rec.latency() == pytest.approx(505.0, rel=0.01)
+        assert rec.max_latency() == 1000.0
+        assert rec.latency_percentile(0.99) >= 950
+        assert rec.qps() > 0
+
+    def test_describe(self):
+        rec = LatencyRecorder(collector=SamplerCollector(interval_s=3600))
+        rec.record(100)
+        d = rec.describe()
+        assert "qps" in d and "p99" in d
+
+
+class TestRegistry:
+    def test_expose_and_dump(self):
+        s = Status(42)
+        s.expose("my_status")
+        assert get_exposed("my_status") is s
+        assert dump_exposed()["my_status"] == "42"
+        s.hide()
+        assert get_exposed("my_status") is None
+
+    def test_passive_status(self):
+        calls = []
+        p = PassiveStatus(lambda: len(calls))
+        p.expose("passive")
+        calls.append(1)
+        assert p.get_value() == 1
+
+    def test_expose_name_normalization(self):
+        Status(1).expose("Foo::Bar baz")
+        assert get_exposed("foo_bar_baz") is not None
+
+    def test_adder_expose(self):
+        a = Adder("requests_total")
+        a.put(3)
+        assert dump_exposed()["requests_total"] == "3"
+
+
+class TestMultiDimension:
+    def test_labels(self):
+        md = MultiDimension(("method", "code"))
+        md.get_stats(("echo", "200")).set_value(5)
+        md.get_stats(("echo", "500")).set_value(1)
+        assert md.count_stats() == 2
+        assert md.get_value()[("echo", "200")] == 5
+
+    def test_arity_check(self):
+        md = MultiDimension(("a",))
+        with pytest.raises(ValueError):
+            md.get_stats(("x", "y"))
+
+
+class TestPrometheus:
+    def test_text_format(self):
+        Status(7).expose("numeric_var")
+        Status("hello").expose("string_var")
+        text = prometheus_text()
+        assert "# TYPE numeric_var gauge" in text
+        assert "numeric_var 7" in text
+        assert "string_var" not in text  # non-numeric excluded
