@@ -64,11 +64,12 @@ class EndPoint:
             rest = text[len("tpu://"):]
             # tpu://host[:port]/ordinal | tpu://host[:port] (ordinal 0)
             # | tpu://mesh/<axis-name>  (collective target: a whole mesh axis)
-            if "/" in rest:
+            had_slash = "/" in rest
+            if had_slash:
                 hostpart, _, ordpart = rest.partition("/")
             else:
                 hostpart, ordpart = rest, "0"
-            if hostpart == "mesh":
+            if hostpart == "mesh" and had_slash:
                 if not ordpart:
                     raise EndPointError(f"missing mesh axis in {text!r}")
                 return EndPoint(kind="tpu", host="mesh", mesh_axis=ordpart)

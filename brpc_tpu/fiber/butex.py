@@ -1,0 +1,59 @@
+"""Butex — wait/wake on a 32-bit word (reference bthread/butex.h:41-84).
+
+The foundation of every blocking primitive in the reference: a fiber waits
+until the word's value differs from an expected value; wakers change the word
+and wake sleepers. Our adaptation keeps the compare-and-sleep contract (it is
+what Stream flow control and call-id join are written against) on top of a
+condition variable; on the TPU datapath the "waker" is a PJRT completion
+callback (SURVEY §5.8: butex signaled from PJRT callback).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class Butex:
+    __slots__ = ("_value", "_cond")
+
+    def __init__(self, value: int = 0):
+        self._value = value
+        self._cond = threading.Condition()
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def set_value(self, value: int) -> None:
+        with self._cond:
+            self._value = value
+
+    def wait(self, expected: int, timeout: Optional[float] = None) -> bool:
+        """Block while value == expected. True if woken, False on timeout.
+
+        Returns immediately if the value already differs (the lost-wakeup
+        guard that makes the butex protocol race-free).
+        """
+        with self._cond:
+            if self._value != expected:
+                return True
+            return self._cond.wait_for(
+                lambda: self._value != expected, timeout=timeout
+            )
+
+    def wake(self, value: Optional[int] = None, n: Optional[int] = None) -> None:
+        """Optionally store a new value, then wake sleepers (all by default)."""
+        with self._cond:
+            if value is not None:
+                self._value = value
+            if n is None:
+                self._cond.notify_all()
+            else:
+                self._cond.notify(n)
+
+    def add_and_wake(self, delta: int = 1) -> int:
+        with self._cond:
+            self._value += delta
+            self._cond.notify_all()
+            return self._value

@@ -1,0 +1,178 @@
+"""Fiber runtime — the M:N task scheduler (reference bthread TaskControl/
+TaskGroup, task_control.cpp:213/task_group.cpp:470).
+
+Semantics carried over, not code: ``start_background`` enqueues a task for
+any worker; ``start_urgent`` runs it at the head of the queue (the
+reference's start_foreground makes the *caller* yield — meaningless under
+the GIL, so urgency maps to queue position); workers own a local deque and
+steal from siblings when idle (Chase-Lev in the reference,
+work_stealing_queue.h:32); tagged worker groups isolate pools
+(task_control.cpp:291). Python threads are the "pthread workers"; tasks are
+plain callables — IO-bound RPC work is where M:N pays off under the GIL,
+and device-bound work is dispatched to XLA asynchronously anyway.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from brpc_tpu.metrics.reducer import Adder
+
+DEFAULT_TAG = 0
+
+
+class FiberTask:
+    __slots__ = ("fn", "args", "done", "error", "_event")
+
+    def __init__(self, fn, args):
+        self.fn = fn
+        self.args = args
+        self.done = False
+        self.error: Optional[BaseException] = None
+        self._event = threading.Event()
+
+    def run(self) -> None:
+        try:
+            self.fn(*self.args)
+        except BaseException as e:  # noqa: BLE001 - task errors are captured
+            self.error = e
+        finally:
+            self.done = True
+            self._event.set()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+
+class _Worker(threading.Thread):
+    def __init__(self, control: "TaskControl", index: int, tag: int):
+        super().__init__(name=f"fiber-worker-{tag}-{index}", daemon=True)
+        self.control = control
+        self.index = index
+        self.tag = tag
+        self.local: deque = deque()
+        self.lock = threading.Lock()
+        self.signal = threading.Event()
+
+    def run(self) -> None:
+        control = self.control
+        while not control._stopped:
+            task = self._next_task()
+            if task is None:
+                self.signal.wait(timeout=0.05)
+                self.signal.clear()
+                continue
+            control.tasks_executed.put(1)
+            task.run()
+
+    def _next_task(self) -> Optional[FiberTask]:
+        with self.lock:
+            if self.local:
+                return self.local.popleft()
+        return self.control._steal(self)
+
+    def push(self, task: FiberTask, urgent: bool) -> None:
+        with self.lock:
+            if urgent:
+                self.local.appendleft(task)
+            else:
+                self.local.append(task)
+        self.signal.set()
+
+
+class TaskControl:
+    """Global scheduler: owns workers per tag group, round-robins submission,
+    lets idle workers steal from siblings."""
+
+    def __init__(self, concurrency: int = 8):
+        self._workers: Dict[int, List[_Worker]] = {}
+        self._rr = itertools.count()
+        self._stopped = False
+        self._lock = threading.Lock()
+        self._default_concurrency = concurrency
+        self.tasks_executed = Adder()
+
+    def _group(self, tag: int) -> List[_Worker]:
+        with self._lock:
+            group = self._workers.get(tag)
+            if group is None:
+                group = [
+                    _Worker(self, i, tag)
+                    for i in range(self._default_concurrency)
+                ]
+                self._workers[tag] = group
+                for w in group:
+                    w.start()
+            return group
+
+    def add_workers(self, n: int, tag: int = DEFAULT_TAG) -> None:
+        with self._lock:
+            group = self._workers.setdefault(tag, [])
+            base = len(group)
+            new = [_Worker(self, base + i, tag) for i in range(n)]
+            group.extend(new)
+        for w in new:
+            w.start()
+
+    def concurrency(self, tag: int = DEFAULT_TAG) -> int:
+        with self._lock:
+            return len(self._workers.get(tag, ())) or self._default_concurrency
+
+    # ------------------------------------------------------------ submission
+    def submit(self, fn: Callable, args=(), urgent: bool = False,
+               tag: int = DEFAULT_TAG) -> FiberTask:
+        task = FiberTask(fn, args)
+        group = self._group(tag)
+        worker = group[next(self._rr) % len(group)]
+        worker.push(task, urgent)
+        return task
+
+    # -------------------------------------------------------------- stealing
+    def _steal(self, thief: _Worker) -> Optional[FiberTask]:
+        group = self._workers.get(thief.tag, ())
+        n = len(group)
+        if n <= 1:
+            return None
+        start = random.randrange(n)
+        for i in range(n):
+            victim = group[(start + i) % n]
+            if victim is thief:
+                continue
+            with victim.lock:
+                if victim.local:
+                    return victim.local.pop()  # steal from the tail
+        return None
+
+    def stop(self) -> None:
+        self._stopped = True
+        with self._lock:
+            groups = [w for g in self._workers.values() for w in g]
+        for w in groups:
+            w.signal.set()
+
+
+_global_control: Optional[TaskControl] = None
+_global_lock = threading.Lock()
+
+
+def global_control() -> TaskControl:
+    global _global_control
+    with _global_lock:
+        if _global_control is None:
+            _global_control = TaskControl()
+        return _global_control
+
+
+def start_background(fn: Callable, *args, tag: int = DEFAULT_TAG) -> FiberTask:
+    """Queue a task for any worker (bthread_start_background)."""
+    return global_control().submit(fn, args, urgent=False, tag=tag)
+
+
+def start_urgent(fn: Callable, *args, tag: int = DEFAULT_TAG) -> FiberTask:
+    """Queue a task at the head — processed before background work
+    (bthread_start_urgent semantics, minus the caller-yield)."""
+    return global_control().submit(fn, args, urgent=True, tag=tag)

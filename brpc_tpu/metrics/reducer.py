@@ -14,6 +14,7 @@ unshared object, no lock acquisition, and reads don't stall writers.
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Callable, Generic, List, TypeVar
 
 T = TypeVar("T")
@@ -24,6 +25,14 @@ class _Agent:
 
     def __init__(self, identity):
         self.value = identity
+
+
+class _AgentAnchor:
+    """Lives in a thread's TLS; its collection (thread death) retires the
+    agent into the reducer's _retired accumulator (the reference folds dying
+    agents back through agent_group's thread-exit hook)."""
+
+    __slots__ = ("__weakref__",)
 
 
 class Reducer(Generic[T]):
@@ -51,10 +60,22 @@ class Reducer(Generic[T]):
         agent = getattr(self._tls, "agent", None)
         if agent is None:
             agent = _Agent(self._identity)
+            anchor = _AgentAnchor()
             self._tls.agent = agent
+            self._tls.anchor = anchor
             with self._agents_lock:
                 self._agents.append(agent)
+            weakref.finalize(anchor, self._retire_agent, agent)
         return agent
+
+    def _retire_agent(self, agent: _Agent) -> None:
+        """Thread died: fold its value into _retired, drop the agent."""
+        with self._agents_lock:
+            try:
+                self._agents.remove(agent)
+            except ValueError:
+                return
+            self._retired = self._op(self._retired, agent.value)
 
     def put(self, value: T) -> "Reducer[T]":
         agent = self._agent()
@@ -64,13 +85,22 @@ class Reducer(Generic[T]):
     __lshift__ = put  # adder << 5, like the reference's operator<<
 
     # ------------------------------------------------------------- read side
-    def get_value(self) -> T:
+    def get_raw_value(self) -> T:
+        """Combined value in the op's own domain (no display clamping)."""
         result = self._retired
         with self._agents_lock:
             agents = list(self._agents)
         for agent in agents:
             result = self._op(result, agent.value)
         return result
+
+    def finalize(self, value: T) -> T:
+        """Map a raw combined value to the displayed value (identity here;
+        Maxer/Miner clamp their +-inf identity to 0)."""
+        return value
+
+    def get_value(self) -> T:
+        return self.finalize(self.get_raw_value())
 
     def reset(self) -> T:
         """Atomically read-and-zero (used by window samplers w/o inverse)."""
@@ -121,15 +151,13 @@ class Maxer(Reducer):
     def __init__(self):
         super().__init__(float("-inf"), max)
 
-    def get_value(self):
-        v = super().get_value()
-        return 0 if v == float("-inf") else v
+    def finalize(self, value):
+        return 0 if value == float("-inf") else value
 
 
 class Miner(Reducer):
     def __init__(self):
         super().__init__(float("inf"), min)
 
-    def get_value(self):
-        v = super().get_value()
-        return 0 if v == float("inf") else v
+    def finalize(self, value):
+        return 0 if value == float("inf") else value

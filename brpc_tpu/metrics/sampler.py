@@ -51,7 +51,7 @@ class SamplerCollector:
     def register(self, sampler: Sampler) -> None:
         with self._lock:
             self._samplers.add(sampler)
-        self._ensure_thread()
+            self._ensure_thread()  # under the lock: exactly one sweeper
 
     def tick_all(self) -> None:
         """Manual tick — the test substrate (no 1 s waits in tests)."""

@@ -41,12 +41,15 @@ class Window(Generic[T]):
             else:
                 oldest = samples[0]
             return self._reducer.inverse(current, oldest)
-        # non-invertible: combine the in-window deltas + live agents
+        # non-invertible: combine in-window deltas + live agents in the op's
+        # raw domain, clamping only at the end (Maxer/Miner finalize maps
+        # their +-inf identity to 0 — combining clamped values would pin
+        # windowed min at <=0)
         samples = self._sampler.recent(self.window_size)
-        result = self._reducer.get_value()
+        result = self._reducer.get_raw_value()
         for s in samples:
             result = self._reducer._op(result, s)
-        return result
+        return self._reducer.finalize(result)
 
     def get_span_seconds(self) -> int:
         return min(self._sampler.sample_count(), self.window_size) or 1

@@ -1,0 +1,25 @@
+"""policy — pluggable protocols, load balancers, naming services, limiters.
+
+Counterpart of the reference's ``src/brpc/policy`` + the registration moment
+``GlobalInitializeOrDie`` (global.cpp:370-626): ``ensure_registered()`` is
+idempotent and wires every built-in policy into the registries.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_done = False
+_lock = threading.Lock()
+
+
+def ensure_registered() -> None:
+    global _done
+    with _lock:
+        if _done:
+            return
+        from brpc_tpu.rpc.protocol import register_protocol
+        from brpc_tpu.policy.trpc_std import TrpcStdProtocol
+
+        register_protocol(TrpcStdProtocol())
+        _done = True

@@ -1,0 +1,118 @@
+"""trpc_std — the canonical binary protocol of the rebuild.
+
+Counterpart of the reference's baidu_std (``policy/baidu_rpc_protocol.cpp``):
+fixed 12-byte header ``b"TRPC" + u32 meta_size + u32 body_size`` followed by
+an RpcMeta protobuf and the body (serialized user message + optional trailing
+attachment of ``meta.attachment_size`` bytes). One protocol serves both
+directions; requests and responses are distinguished by which sub-meta is set.
+
+The server-side dispatch mirrors ``ProcessRpcRequest`` (baidu_rpc_protocol.
+cpp:565): admission -> method lookup -> parse -> user code -> SendResponse;
+the client side mirrors ``ProcessRpcResponse`` (:907): verify call id ->
+deserialize -> end RPC.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional, Tuple
+
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.butil.misc import crc32c
+from brpc_tpu.proto import rpc_meta_pb2
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.protocol import (
+    PARSE_BAD,
+    PARSE_NOT_ENOUGH_DATA,
+    PARSE_TRY_OTHERS,
+    ParsedMessage,
+    Protocol,
+)
+
+MAGIC = b"TRPC"
+HEADER_FMT = "!4sII"
+HEADER_SIZE = struct.calcsize(HEADER_FMT)  # 12
+MAX_BODY_SIZE = 1 << 31
+
+
+class TrpcStdProtocol(Protocol):
+    name = "trpc_std"
+    magic = MAGIC
+
+    # ------------------------------------------------------------------ wire
+    def parse(self, buf: IOBuf) -> Tuple[int, Optional[ParsedMessage]]:
+        if len(buf) < HEADER_SIZE:
+            # can we at least rule the protocol out?
+            head = buf.fetch(min(len(buf), 4))
+            if head and not MAGIC.startswith(head):
+                return PARSE_TRY_OTHERS, None
+            return PARSE_NOT_ENOUGH_DATA, None
+        header = buf.fetch(HEADER_SIZE)
+        magic, meta_size, body_size = struct.unpack(HEADER_FMT, header)
+        if magic != MAGIC:
+            return PARSE_TRY_OTHERS, None
+        if meta_size + body_size > MAX_BODY_SIZE:
+            return PARSE_BAD, None
+        total = HEADER_SIZE + meta_size + body_size
+        if len(buf) < total:
+            return PARSE_NOT_ENOUGH_DATA, None
+        buf.pop_front(HEADER_SIZE)
+        meta_bytes = buf.cutn(meta_size).tobytes()
+        body = buf.cutn(body_size)
+        try:
+            meta = rpc_meta_pb2.RpcMeta.FromString(meta_bytes)
+        except Exception:
+            return PARSE_BAD, None
+        return 0, ParsedMessage(self, meta, body)
+
+    @staticmethod
+    def _pack(meta: rpc_meta_pb2.RpcMeta, payload: bytes,
+              attachment: bytes = b"", checksum: bool = False) -> IOBuf:
+        meta.attachment_size = len(attachment)
+        body_size = len(payload) + len(attachment)
+        if payload and checksum:
+            meta.checksum = crc32c(payload)
+        meta_bytes = meta.SerializeToString()
+        out = IOBuf()
+        out.append(struct.pack(HEADER_FMT, MAGIC, len(meta_bytes), body_size))
+        out.append(meta_bytes)
+        if payload:
+            out.append(payload)
+        if attachment:
+            out.append(attachment)
+        return out
+
+    def pack_request(self, meta, payload: bytes, attachment: bytes = b"",
+                     checksum: bool = False) -> IOBuf:
+        return self._pack(meta, payload, attachment, checksum)
+
+    def pack_response(self, meta, payload: bytes, attachment: bytes = b"",
+                      checksum: bool = False) -> IOBuf:
+        return self._pack(meta, payload, attachment, checksum)
+
+    # ------------------------------------------------------------ server side
+    def process_request(self, msg: ParsedMessage, server) -> None:
+        # deferred import: protocol layer must not depend on server at import
+        from brpc_tpu.rpc.server_processing import process_rpc_request
+
+        process_rpc_request(self, msg, server)
+
+    # ------------------------------------------------------------ client side
+    def process_response(self, msg: ParsedMessage) -> None:
+        from brpc_tpu.rpc.controller import handle_response_message
+
+        handle_response_message(msg)
+
+    # --------------------------------------------------------------- helpers
+    @staticmethod
+    def split_attachment(msg: ParsedMessage) -> Tuple[bytes, bytes]:
+        """body -> (serialized message bytes, attachment bytes)."""
+        att_size = msg.meta.attachment_size
+        body = msg.body.tobytes()
+        if att_size:
+            return body[:-att_size], body[-att_size:]
+        return body, b""
+
+    @staticmethod
+    def verify_checksum(meta, payload: bytes) -> bool:
+        return not meta.checksum or crc32c(payload) == meta.checksum

@@ -1,0 +1,26 @@
+"""rpc — the transport & RPC engine (SURVEY §2.4)."""
+
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.channel import Channel, ChannelOptions, MethodDescriptor, RpcError, Stub
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.server import Server, ServerOptions, Service
+from brpc_tpu.rpc.socket import Socket
+from brpc_tpu.rpc.event_dispatcher import EventDispatcher, global_dispatcher
+from brpc_tpu.rpc.input_messenger import InputMessenger
+
+__all__ = [
+    "errors",
+    "Channel",
+    "ChannelOptions",
+    "MethodDescriptor",
+    "RpcError",
+    "Stub",
+    "Controller",
+    "Server",
+    "ServerOptions",
+    "Service",
+    "Socket",
+    "EventDispatcher",
+    "global_dispatcher",
+    "InputMessenger",
+]

@@ -1,0 +1,166 @@
+"""Channel — the client stub (reference channel.cpp:293,379,433).
+
+``init`` accepts a single endpoint ("host:port", "unix:...", "tpu://...")
+or a naming-service url + load balancer name ("list://a:1,b:2", "rr").
+``call_method`` drives the full client call stack of SURVEY §3.1: controller
+setup -> call-id creation -> timers -> serialize -> issue (LB select, pack,
+wait-free write) -> sync join or async done.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.fiber import call_id as _cid
+from brpc_tpu.metrics.latency_recorder import LatencyRecorder
+from brpc_tpu.policy import compress as _compress
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.protocol import find_protocol
+from brpc_tpu.rpc.socket_map import global_socket_map
+
+
+@dataclass
+class MethodDescriptor:
+    service_name: str
+    method_name: str
+    request_class: type = None
+    response_class: type = None
+
+    @staticmethod
+    def from_pb(method_desc) -> "MethodDescriptor":
+        from google.protobuf import message_factory
+
+        return MethodDescriptor(
+            service_name=method_desc.containing_service.name,
+            method_name=method_desc.name,
+            request_class=message_factory.GetMessageClass(method_desc.input_type),
+            response_class=message_factory.GetMessageClass(method_desc.output_type),
+        )
+
+
+@dataclass
+class ChannelOptions:
+    """reference channel.h:42-140 (the subset that exists so far)."""
+
+    timeout_ms: int = 1000
+    connect_timeout_ms: int = 3000
+    max_retry: int = 3
+    backup_request_ms: int = 0  # 0 = disabled
+    protocol: str = "trpc_std"
+    compress_type: int = _compress.COMPRESS_NONE
+    # crc32c over the body. Off by default: TCP already checksums, and the
+    # pure-Python fallback is slow on MB payloads (the native core makes
+    # this cheap — flip on for lossy transports).
+    enable_checksum: bool = False
+
+
+class Channel:
+    def __init__(self, options: Optional[ChannelOptions] = None):
+        self.options = options or ChannelOptions()
+        self._protocol = None
+        self._remote: Optional[EndPoint] = None
+        self._lb = None
+        self._ns_thread = None
+        self._socket_map = None
+        self._init_done = False
+        self.latency_recorder = LatencyRecorder()
+
+    # ------------------------------------------------------------------ init
+    def init(self, target: str, lb_name: Optional[str] = None) -> "Channel":
+        from brpc_tpu.policy import ensure_registered
+
+        ensure_registered()
+        self._protocol = find_protocol(self.options.protocol)
+        if self._protocol is None:
+            raise ValueError(f"unknown protocol {self.options.protocol!r}")
+        self._socket_map = global_socket_map()
+        if lb_name:
+            from brpc_tpu.policy.load_balancers import create_load_balancer
+            from brpc_tpu.policy.naming import start_naming_service
+
+            self._lb = create_load_balancer(lb_name)
+            self._ns_thread = start_naming_service(target, self._lb)
+        else:
+            self._remote = EndPoint.parse(target)
+        self._init_done = True
+        return self
+
+    # ------------------------------------------------------------ call stack
+    def call_method(self, method: MethodDescriptor, request,
+                    response=None, controller: Optional[Controller] = None,
+                    done=None):
+        """Sync when done is None (returns response); async otherwise
+        (returns the controller immediately)."""
+        if not self._init_done:
+            raise RuntimeError("Channel.init() not called")
+        cntl = controller or Controller()
+        if response is None and method.response_class is not None:
+            response = method.response_class()
+        if cntl.compress_type == _compress.COMPRESS_NONE:
+            cntl.compress_type = self.options.compress_type
+        cid = cntl._begin_call(self, method, request, response, done)
+        _cid.id_lock(cid)
+        cntl._issue_rpc()
+        _cid.id_unlock(cid)
+        if done is not None:
+            return cntl
+        cntl.join()
+        if cntl.failed():
+            raise RpcError(cntl)
+        return response
+
+    # ------------------------------------------------------------- internals
+    def _select_socket(self, cntl: Controller):
+        if self._lb is not None:
+            ep = self._lb.select_server(cntl)
+            if ep is None:
+                raise ConnectionError("no available server")
+        else:
+            ep = self._remote
+        if ep.is_tpu():
+            from brpc_tpu.tpu.tpusocket import get_tpu_socket
+
+            return get_tpu_socket(ep)
+        return self._socket_map.get_or_create(
+            ep, connect_timeout=self.options.connect_timeout_ms / 1000.0
+        )
+
+    def _on_rpc_end(self, cntl: Controller) -> None:
+        self.latency_recorder.record(cntl.latency_us)
+        if self._lb is not None and cntl._current_socket is not None:
+            self._lb.feedback(cntl._current_socket.remote,
+                              cntl.error_code, cntl.latency_us)
+
+
+class RpcError(Exception):
+    def __init__(self, cntl: Controller):
+        super().__init__(f"[E{cntl.error_code}] {cntl.error_text()}")
+        self.controller = cntl
+        self.error_code = cntl.error_code
+
+
+class Stub:
+    """Typed call surface generated from a pb service descriptor.
+
+    stub = Stub(channel, echo_pb2.DESCRIPTOR.services_by_name['EchoService'])
+    resp = stub.Echo(request)                      # sync
+    cntl = stub.Echo(request, done=cb)             # async
+    """
+
+    def __init__(self, channel: Channel, service_descriptor):
+        self._channel = channel
+        for mdesc in service_descriptor.methods:
+            md = MethodDescriptor.from_pb(mdesc)
+            setattr(self, mdesc.name, self._make_call(md))
+
+    def _make_call(self, md: MethodDescriptor):
+        def call(request, response=None, controller=None, done=None):
+            return self._channel.call_method(
+                md, request, response=response, controller=controller, done=done
+            )
+
+        return call

@@ -1,0 +1,258 @@
+"""Controller — per-RPC state machine, client and server roles.
+
+Rebuild of ``controller.cpp`` (client path: IssueRPC :1047,
+OnVersionedRPCReturned :598, EndRPC :874; server path: peer/attachment
+accessors). Every client-side state transition — response arrival, timeout,
+socket failure, backup-request fire, retry — happens under the RPC's call-id
+lock, and stale attempt responses are rejected by attempt-version
+verification (the controller.cpp:1059-1066 race guard).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.fiber import call_id as _cid
+from brpc_tpu.fiber.timer import timer_add, timer_del
+from brpc_tpu.policy import compress as _compress
+from brpc_tpu.proto import rpc_meta_pb2
+from brpc_tpu.rpc import errors
+
+
+class Controller:
+    def __init__(self):
+        # shared
+        self._error_code = errors.OK
+        self._error_text = ""
+        self.request_attachment = b""
+        self.response_attachment = b""
+        self.log_id = 0
+        self.compress_type = _compress.COMPRESS_NONE
+        # client side
+        self.timeout_ms: Optional[int] = None
+        self.backup_request_ms: Optional[int] = None
+        self.max_retry: Optional[int] = None
+        self._retry_count = 0
+        self._backup_sent = False
+        self._call_id: Optional[int] = None
+        self._channel = None
+        self._method = None
+        self._request = None
+        self._response = None
+        self._done: Optional[Callable] = None
+        self._timeout_timer: Optional[int] = None
+        self._backup_timer: Optional[int] = None
+        self._start_us = 0
+        self.latency_us = 0
+        self._current_socket = None
+        self._finished = False
+        # server side
+        self.is_server_side = False
+        self.server = None
+        self.peer = None
+        self.method_name = ""
+        self.service_name = ""
+        self._srv_meta = None
+        self._srv_socket = None
+        self._response_sent = False
+        # tracing
+        self.span = None
+
+    # ----------------------------------------------------------------- state
+    def failed(self) -> bool:
+        return self._error_code != errors.OK
+
+    @property
+    def error_code(self) -> int:
+        return self._error_code
+
+    def error_text(self) -> str:
+        return self._error_text
+
+    def set_failed(self, code: int, text: str = "") -> None:
+        self._error_code = code
+        self._error_text = text or errors.error_text(code)
+
+    def call_id(self) -> Optional[int]:
+        return self._call_id
+
+    @property
+    def response(self):
+        return self._response
+
+    # ============================================================ client role
+    def _begin_call(self, channel, method, request, response, done) -> int:
+        self._channel = channel
+        self._method = method
+        self._request = request
+        self._response = response
+        self._done = done
+        self._start_us = time.perf_counter_ns() // 1000
+        self._call_id = _cid.id_create(data=self, on_error=_handle_id_error)
+        opts = channel.options
+        if self.timeout_ms is None:
+            self.timeout_ms = opts.timeout_ms
+        if self.max_retry is None:
+            self.max_retry = opts.max_retry
+        if self.backup_request_ms is None:
+            self.backup_request_ms = opts.backup_request_ms
+        if self.timeout_ms and self.timeout_ms > 0:
+            self._timeout_timer = timer_add(
+                _fire_id_error, self.timeout_ms / 1000.0,
+                self._call_id, errors.ERPCTIMEDOUT,
+            )
+        if self.backup_request_ms and self.backup_request_ms > 0:
+            self._backup_timer = timer_add(
+                _fire_id_error, self.backup_request_ms / 1000.0,
+                self._call_id, errors.EBACKUPREQUEST,
+            )
+        return self._call_id
+
+    def _issue_rpc(self) -> None:
+        """Pick a socket, pack, write. Caller holds the call-id lock."""
+        cid = self._call_id
+        try:
+            sock = self._channel._select_socket(self)
+        except Exception as e:
+            # route the failure through the error channel (deferred while we
+            # hold the lock) so retry logic sees one uniform path
+            self._error_text = str(e)
+            _cid.id_error(cid, errors.EHOSTDOWN)
+            return
+        self._current_socket = sock
+        meta = rpc_meta_pb2.RpcMeta()
+        meta.request.service_name = self._method.service_name
+        meta.request.method_name = self._method.method_name
+        meta.request.log_id = self.log_id
+        meta.request.timeout_ms = self.timeout_ms or 0
+        meta.correlation_id = cid
+        meta.attempt_version = _cid.id_version(cid)
+        meta.compress_type = self.compress_type
+        if self.span is not None:
+            meta.request.trace_id = self.span.trace_id
+            meta.request.span_id = self.span.span_id
+        payload = _compress.compress(
+            self._request.SerializeToString(), self.compress_type
+        )
+        packet = self._channel._protocol.pack_request(
+            meta, payload, self.request_attachment,
+            checksum=self._channel.options.enable_checksum,
+        )
+        rc = sock.write(packet, id_wait=cid)
+        if rc not in (0, errors.EFAILEDSOCKET):
+            # overcrowded etc: surface through the error channel
+            _cid.id_error(cid, rc)
+
+    # ----------------------------------------------------- error/retry logic
+    def _on_id_error(self, code: int) -> None:
+        """Runs with the call-id lock held."""
+        if self._finished:
+            _cid.id_unlock(self._call_id)
+            return
+        if code == errors.EBACKUPREQUEST:
+            # hedge: duplicate the attempt, same version — first response wins
+            if not self._backup_sent and not self.failed():
+                self._backup_sent = True
+                self._issue_rpc()
+            _cid.id_unlock(self._call_id)
+            return
+        retryable = code in errors.DEFAULT_RETRYABLE and code != errors.EBACKUPREQUEST
+        if retryable and self._retry_count < (self.max_retry or 0):
+            self._retry_count += 1
+            _cid.id_bump_version(self._call_id)  # stale responses now dropped
+            self._issue_rpc()
+            _cid.id_unlock(self._call_id)
+            return
+        self.set_failed(code)
+        self._finish_locked()
+
+    def _on_response(self, meta, payload: bytes, attachment: bytes) -> None:
+        """Runs with the call-id lock held (version already verified)."""
+        if self._finished:
+            _cid.id_unlock(self._call_id)
+            return
+        if meta.response.error_code != errors.OK:
+            self.set_failed(meta.response.error_code,
+                            meta.response.error_text)
+            self._finish_locked()
+            return
+        try:
+            data = _compress.decompress(payload, meta.compress_type)
+            if self._response is not None:
+                self._response.ParseFromString(data)
+            self.response_attachment = attachment
+        except Exception as e:
+            self.set_failed(errors.ERESPONSE, f"parse response: {e}")
+        self._finish_locked()
+
+    def _finish_locked(self) -> None:
+        """Complete the RPC: cancel timers, wake joiners, run done."""
+        self._finished = True
+        cid = self._call_id
+        if self._timeout_timer is not None:
+            timer_del(self._timeout_timer)
+        if self._backup_timer is not None:
+            timer_del(self._backup_timer)
+        if self._current_socket is not None:
+            self._current_socket.remove_pending_id(cid)
+        self.latency_us = time.perf_counter_ns() // 1000 - self._start_us
+        if self._channel is not None:
+            self._channel._on_rpc_end(self)
+        done = self._done
+        _cid.id_about_to_destroy(cid)
+        _cid.id_unlock_and_destroy(cid)
+        if done is not None:
+            try:
+                done(self)
+            except Exception:
+                pass
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        if self._call_id is None:
+            return True
+        return _cid.id_join(self._call_id, timeout)
+
+    # ============================================================ server role
+    @classmethod
+    def server_controller(cls, server, sock, meta) -> "Controller":
+        c = cls()
+        c.is_server_side = True
+        c.server = server
+        c._srv_socket = sock
+        c._srv_meta = meta
+        c.peer = sock.remote
+        c.service_name = meta.request.service_name
+        c.method_name = meta.request.method_name
+        c.log_id = meta.request.log_id
+        return c
+
+
+def _handle_id_error(data, call_id: int, code: int) -> None:
+    """on_error hook registered at id_create; lock is held on entry."""
+    cntl: Controller = data
+    cntl._on_id_error(code)
+
+
+def _fire_id_error(call_id: int, code: int) -> None:
+    """Timer thread -> error channel (never blocks the timer thread long)."""
+    _cid.id_error(call_id, code)
+
+
+def handle_response_message(msg) -> None:
+    """Client-side entry from InputMessenger (reference ProcessRpcResponse)."""
+    from brpc_tpu.policy.trpc_std import TrpcStdProtocol
+
+    meta = msg.meta
+    cid = meta.correlation_id
+    try:
+        cntl = _cid.id_lock_verify(cid, meta.attempt_version)
+    except _cid.IdGone:
+        return  # stale attempt or finished RPC: drop silently
+    payload, attachment = TrpcStdProtocol.split_attachment(msg)
+    if not TrpcStdProtocol.verify_checksum(meta, payload):
+        cntl.set_failed(errors.ERESPONSE, "response checksum mismatch")
+        cntl._finish_locked()
+        return
+    cntl._on_response(meta, payload, attachment)

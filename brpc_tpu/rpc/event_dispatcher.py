@@ -1,0 +1,146 @@
+"""EventDispatcher — the IO event loop feeding the fiber runtime.
+
+Rebuild of ``event_dispatcher_epoll.cpp:196-206``: one (or more) dedicated
+threads blocked in epoll; events never read data themselves — they fire the
+consumer's callback (``AddConsumer``, event_dispatcher.h:122). Registration
+changes from other threads are applied through a self-pipe wakeup so the
+loop never holds stale interest sets.
+
+Read callbacks run on the dispatcher thread (which drains the fd and hands
+complete messages to fiber workers — the reference's ProcessEvent handoff
+happens at the message level, SURVEY §3.1); write callbacks drain pending
+write queues.
+"""
+
+from __future__ import annotations
+
+import os
+import selectors
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from brpc_tpu.metrics.reducer import Adder
+
+
+class EventDispatcher:
+    def __init__(self, name: str = "event-dispatcher"):
+        self._selector = selectors.DefaultSelector()
+        self._lock = threading.Lock()
+        self._handlers: Dict[int, Tuple[Optional[Callable], Optional[Callable]]] = {}
+        self._wake_r, self._wake_w = os.pipe()
+        os.set_blocking(self._wake_r, False)
+        self._selector.register(self._wake_r, selectors.EVENT_READ, None)
+        self._stopped = False
+        self.events_dispatched = Adder()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------- api
+    def add_consumer(self, fd: int, on_readable: Optional[Callable] = None,
+                     on_writable: Optional[Callable] = None) -> None:
+        events = 0
+        if on_readable:
+            events |= selectors.EVENT_READ
+        if on_writable:
+            events |= selectors.EVENT_WRITE
+        with self._lock:
+            self._handlers[fd] = (on_readable, on_writable)
+            try:
+                self._selector.modify(fd, events, fd)
+            except KeyError:
+                self._selector.register(fd, events, fd)
+        self._wakeup()
+
+    def enable_write(self, fd: int, on_writable: Callable) -> None:
+        with self._lock:
+            r, _ = self._handlers.get(fd, (None, None))
+            self._handlers[fd] = (r, on_writable)
+            events = selectors.EVENT_WRITE | (selectors.EVENT_READ if r else 0)
+            try:
+                self._selector.modify(fd, events, fd)
+            except KeyError:
+                self._selector.register(fd, events, fd)
+        self._wakeup()
+
+    def disable_write(self, fd: int) -> None:
+        with self._lock:
+            r, _ = self._handlers.get(fd, (None, None))
+            self._handlers[fd] = (r, None)
+            if r:
+                try:
+                    self._selector.modify(fd, selectors.EVENT_READ, fd)
+                except KeyError:
+                    pass
+            else:
+                self._remove_locked(fd)
+        self._wakeup()
+
+    def remove_consumer(self, fd: int) -> None:
+        with self._lock:
+            self._remove_locked(fd)
+        self._wakeup()
+
+    def _remove_locked(self, fd: int) -> None:
+        self._handlers.pop(fd, None)
+        try:
+            self._selector.unregister(fd)
+        except KeyError:
+            pass
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._wakeup()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)
+
+    # ------------------------------------------------------------------ loop
+    def _wakeup(self) -> None:
+        try:
+            os.write(self._wake_w, b"\x00")
+        except OSError:
+            pass
+
+    def _run(self) -> None:
+        while not self._stopped:
+            try:
+                events = self._selector.select(timeout=1.0)
+            except OSError:
+                continue
+            for key, mask in events:
+                if key.fd == self._wake_r:
+                    try:
+                        while os.read(self._wake_r, 4096):
+                            pass
+                    except BlockingIOError:
+                        pass
+                    continue
+                with self._lock:
+                    on_r, on_w = self._handlers.get(key.fd, (None, None))
+                self.events_dispatched.put(1)
+                if mask & selectors.EVENT_READ and on_r:
+                    try:
+                        on_r()
+                    except Exception:
+                        pass
+                if mask & selectors.EVENT_WRITE and on_w:
+                    try:
+                        on_w()
+                    except Exception:
+                        pass
+        try:
+            self._selector.close()
+        except OSError:
+            pass
+
+
+_global: Optional[EventDispatcher] = None
+_global_lock = threading.Lock()
+
+
+def global_dispatcher() -> EventDispatcher:
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = EventDispatcher()
+        return _global

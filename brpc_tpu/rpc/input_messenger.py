@@ -1,0 +1,93 @@
+"""InputMessenger — cuts messages from the byte stream, routes to protocols.
+
+Rebuild of ``input_messenger.cpp:360`` (OnNewMessages): drain the fd, loop
+cutting complete messages, remember the socket's preferred protocol after the
+first successful parse, and hand messages to fiber workers for processing —
+in per-socket order (the reference uses fresh bthreads + inline-last; we use
+a per-socket ExecutionQueue, which preserves arrival order without a
+dedicated thread, SURVEY §2.2 ExecutionQueue row).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from brpc_tpu.fiber import runtime
+from brpc_tpu.rpc.protocol import (
+    PARSE_BAD,
+    PARSE_NOT_ENOUGH_DATA,
+    PARSE_TRY_OTHERS,
+    ParsedMessage,
+    list_protocols,
+)
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.socket import Socket
+
+
+class InputMessenger:
+    def __init__(self, server=None):
+        self._server = server
+
+    def make_on_readable(self, sock: Socket):
+        """The dispatcher callback for this socket's read events."""
+
+        def on_readable():
+            n = sock.drain_recv()
+            if n < 0:
+                return
+            self.cut_messages(sock)
+
+        return on_readable
+
+    def cut_messages(self, sock: Socket) -> int:
+        """Parse complete messages in arrival order, then fan processing out
+        to fiber workers — one task per message, like the reference's
+        per-message bthreads (input_messenger.cpp:194-239). Cutting stays
+        serial on the dispatcher thread; processing is parallel so one slow
+        handler never blocks the connection (protocols needing strict order,
+        e.g. stream frames, re-serialize in their own ExecutionQueue)."""
+        count = 0
+        server = self._server
+        while len(sock.read_buf):
+            msg = self._cut_one(sock)
+            if msg is None:
+                break
+            msg.socket = sock
+            sock.in_messages += 1
+            count += 1
+            runtime.start_background(_process_one, msg, server)
+        return count
+
+    def _cut_one(self, sock: Socket) -> Optional[ParsedMessage]:
+        protocols = list_protocols()
+        # preferred protocol first (input_messenger.cpp preferred_index)
+        if sock.preferred_protocol is not None:
+            protocols = [sock.preferred_protocol] + [
+                p for p in protocols if p is not sock.preferred_protocol
+            ]
+        for proto in protocols:
+            rc, msg = proto.parse(sock.read_buf)
+            if rc == PARSE_NOT_ENOUGH_DATA:
+                return None
+            if rc == PARSE_TRY_OTHERS:
+                continue
+            if rc == PARSE_BAD:
+                sock.set_failed(errors.EREQUEST, f"bad {proto.name} message")
+                return None
+            sock.preferred_protocol = proto
+            return msg
+        # no protocol recognises these bytes
+        sock.set_failed(errors.EREQUEST, "unknown protocol")
+        return None
+
+
+def _process_one(msg, server) -> None:
+    try:
+        if msg.meta.HasField("request"):
+            msg.protocol.process_request(
+                msg, server or msg.socket.owner_server
+            )
+        else:
+            msg.protocol.process_response(msg)
+    except Exception:
+        pass

@@ -1,0 +1,79 @@
+"""Protocol registry — pluggable wire protocols tried in order.
+
+Rebuild of the reference's ``protocol.h:77-172`` struct-of-function-pointers +
+registration at GlobalInitializeOrDie (``global.cpp:421-601``): a Protocol
+knows how to (a) cut one message out of a read buffer, (b) pack a request,
+(c) process a request server-side, (d) process a response client-side. The
+InputMessenger tries registered protocols in order and remembers each
+socket's preferred protocol after the first match.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from brpc_tpu.butil.iobuf import IOBuf
+
+# parse results (reference ParseResult/ParseError)
+PARSE_OK = 0
+PARSE_NOT_ENOUGH_DATA = 1
+PARSE_TRY_OTHERS = 2
+PARSE_BAD = 3
+
+
+class ParsedMessage:
+    """One complete wire message, protocol-tagged."""
+
+    __slots__ = ("protocol", "meta", "body", "socket")
+
+    def __init__(self, protocol: "Protocol", meta, body: IOBuf):
+        self.protocol = protocol
+        self.meta = meta
+        self.body = body
+        self.socket = None
+
+
+class Protocol:
+    """Subclass per protocol. name must be unique."""
+
+    name = "base"
+    # protocols whose first bytes are a fixed magic can be probed cheaply
+    magic: Optional[bytes] = None
+
+    def parse(self, buf: IOBuf) -> Tuple[int, Optional[ParsedMessage]]:
+        """Try to cut ONE message from buf. Returns (PARSE_*, msg|None)."""
+        raise NotImplementedError
+
+    def pack_request(self, meta, payload: bytes) -> IOBuf:
+        raise NotImplementedError
+
+    def pack_response(self, meta, payload: bytes) -> IOBuf:
+        raise NotImplementedError
+
+    def process_request(self, msg: ParsedMessage, server) -> None:
+        raise NotImplementedError
+
+    def process_response(self, msg: ParsedMessage) -> None:
+        raise NotImplementedError
+
+
+_protocols: List[Protocol] = []
+_by_name: Dict[str, Protocol] = {}
+_lock = threading.Lock()
+
+
+def register_protocol(proto: Protocol) -> None:
+    with _lock:
+        if proto.name in _by_name:
+            raise ValueError(f"protocol {proto.name!r} already registered")
+        _by_name[proto.name] = proto
+        _protocols.append(proto)
+
+
+def find_protocol(name: str) -> Optional[Protocol]:
+    return _by_name.get(name)
+
+
+def list_protocols() -> List[Protocol]:
+    return list(_protocols)

@@ -1,0 +1,232 @@
+"""Server — service registry + acceptor + per-method stats.
+
+Rebuild of ``server.cpp`` (Start :1276/StartInternal :845, builtin services
+:499-601, method maps) and ``acceptor.cpp`` (the listening socket accepts
+until EAGAIN and spawns per-connection sockets, :250,336). Server-side
+request processing lives in server_processing.py.
+"""
+
+from __future__ import annotations
+
+import socket as _socket
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.metrics.latency_recorder import LatencyRecorder
+from brpc_tpu.metrics.reducer import Adder
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.event_dispatcher import global_dispatcher
+from brpc_tpu.rpc.input_messenger import InputMessenger
+from brpc_tpu.rpc.socket import Socket
+
+
+class Service:
+    """Base for user services.
+
+    Two ways to define one:
+      - protobuf: subclass with DESCRIPTOR = pb ServiceDescriptor; implement
+        a method per rpc (same name) with signature (controller, request,
+        done) -> optional response. If the method returns a response without
+        calling done, the framework sends it (sync style).
+      - manual: subclass and call add_method(name, fn, req_cls, resp_cls).
+    """
+
+    DESCRIPTOR = None  # pb ServiceDescriptor, set by subclass
+
+    def __init__(self):
+        self._methods: Dict[str, "MethodEntry"] = {}
+        if self.DESCRIPTOR is not None:
+            from google.protobuf import message_factory
+
+            for mdesc in self.DESCRIPTOR.methods:
+                impl = getattr(self, mdesc.name, None)
+                if impl is None:
+                    continue
+                self._methods[mdesc.name] = MethodEntry(
+                    name=mdesc.name,
+                    fn=impl,
+                    request_class=message_factory.GetMessageClass(mdesc.input_type),
+                    response_class=message_factory.GetMessageClass(mdesc.output_type),
+                )
+
+    @property
+    def service_name(self) -> str:
+        if self.DESCRIPTOR is not None:
+            return self.DESCRIPTOR.name
+        return type(self).__name__
+
+    def add_method(self, name: str, fn, request_class, response_class) -> None:
+        self._methods[name] = MethodEntry(name, fn, request_class, response_class)
+
+    def find_method(self, name: str) -> Optional["MethodEntry"]:
+        return self._methods.get(name)
+
+
+@dataclass
+class MethodEntry:
+    name: str
+    fn: object
+    request_class: type
+    response_class: type
+    # per-method instrumentation (reference details/method_status.cpp)
+    latency: LatencyRecorder = field(default_factory=LatencyRecorder)
+    errors_count: Adder = field(default_factory=Adder)
+    current_concurrency: int = 0
+    max_concurrency: int = 0  # 0 = unlimited; limiter hooks attach here
+    _conc_lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def on_request(self) -> bool:
+        """Admission check; False -> ELIMIT."""
+        with self._conc_lock:
+            if self.max_concurrency and self.current_concurrency >= self.max_concurrency:
+                return False
+            self.current_concurrency += 1
+            return True
+
+    def on_response(self, latency_us: float, error_code: int) -> None:
+        with self._conc_lock:
+            self.current_concurrency -= 1
+        self.latency.record(latency_us)
+        if error_code != errors.OK:
+            self.errors_count.put(1)
+
+
+@dataclass
+class ServerOptions:
+    """reference server.h:62-136 (growing subset)."""
+
+    num_workers: int = 8
+    max_concurrency: int = 0          # whole-server admission
+    auth: object = None               # Authenticator (policy/auth.py)
+    idle_timeout_s: int = -1
+
+
+class Server:
+    def __init__(self, options: Optional[ServerOptions] = None):
+        self.options = options or ServerOptions()
+        self._services: Dict[str, Service] = {}
+        self._listen_sock: Optional[_socket.socket] = None
+        self._listen_ep: Optional[EndPoint] = None
+        self._connections: Set[Socket] = set()
+        self._conn_lock = threading.Lock()
+        self._running = False
+        self._logoff = False
+        self._messenger = InputMessenger(server=self)
+        self._dispatcher = global_dispatcher()
+        self.concurrency = 0
+        self._concurrency_lock = threading.Lock()
+        self.requests_processed = Adder()
+
+    # -------------------------------------------------------------- services
+    def add_service(self, service: Service) -> "Server":
+        name = service.service_name
+        if name in self._services:
+            raise ValueError(f"service {name!r} already added")
+        self._services[name] = service
+        return self
+
+    def find_service(self, name: str) -> Optional[Service]:
+        return self._services.get(name)
+
+    @property
+    def services(self) -> Dict[str, Service]:
+        return dict(self._services)
+
+    # ----------------------------------------------------------- start/stop
+    def start(self, address: str = "127.0.0.1:0") -> "Server":
+        from brpc_tpu.policy import ensure_registered
+
+        ensure_registered()
+        ep = EndPoint.parse(address)
+        fam, addr = ep.sockaddr()
+        lsock = _socket.socket(fam, _socket.SOCK_STREAM)
+        lsock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        lsock.bind(addr)
+        lsock.listen(1024)
+        lsock.setblocking(False)
+        self._listen_sock = lsock
+        host, port = lsock.getsockname()[:2]
+        self._listen_ep = EndPoint.from_ip_port(host, port)
+        self._running = True
+        self._logoff = False
+        self._dispatcher.add_consumer(
+            lsock.fileno(), on_readable=self._on_new_connections
+        )
+        return self
+
+    def listen_endpoint(self) -> Optional[EndPoint]:
+        return self._listen_ep
+
+    def stop(self) -> None:
+        """Graceful: reject new requests (ELOGOFF), keep serving in-flight."""
+        self._logoff = True
+        if self._listen_sock is not None:
+            try:
+                self._dispatcher.remove_consumer(self._listen_sock.fileno())
+                self._listen_sock.close()
+            except OSError:
+                pass
+            self._listen_sock = None
+
+    def join(self, timeout: float = 5.0) -> None:
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._concurrency_lock:
+                if self.concurrency == 0:
+                    break
+            time.sleep(0.01)
+        with self._conn_lock:
+            conns = list(self._connections)
+        for c in conns:
+            c.close()
+        self._running = False
+
+    @property
+    def is_running(self) -> bool:
+        return self._running and not self._logoff
+
+    # -------------------------------------------------------------- acceptor
+    def _on_new_connections(self) -> None:
+        """accept until EAGAIN (reference acceptor.cpp OnNewConnections)."""
+        while self._listen_sock is not None:
+            try:
+                conn, peer = self._listen_sock.accept()
+            except (BlockingIOError, OSError):
+                return
+            conn.setblocking(False)
+            try:
+                conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            remote = EndPoint.from_ip_port(*peer[:2]) if isinstance(peer, tuple) else None
+            sock = Socket(conn, remote, self._dispatcher)
+            sock.owner_server = self
+            sock._on_readable = self._messenger.make_on_readable(sock)
+            sock.register_read()
+            with self._conn_lock:
+                self._connections.add(sock)
+
+    def _on_connection_closed(self, sock: Socket) -> None:
+        with self._conn_lock:
+            self._connections.discard(sock)
+
+    def connection_count(self) -> int:
+        with self._conn_lock:
+            return len(self._connections)
+
+    # ------------------------------------------------------------- admission
+    def add_concurrency(self) -> bool:
+        with self._concurrency_lock:
+            if (self.options.max_concurrency
+                    and self.concurrency >= self.options.max_concurrency):
+                return False
+            self.concurrency += 1
+            return True
+
+    def sub_concurrency(self) -> None:
+        with self._concurrency_lock:
+            self.concurrency -= 1

@@ -1,0 +1,133 @@
+"""Server-side request processing (reference ProcessRpcRequest,
+policy/baidu_rpc_protocol.cpp:565-854, and SendRpcResponse :270).
+
+Pipeline: logoff/admission checks -> service+method lookup -> attachment
+split -> checksum -> decompress+parse -> user code -> send response. Runs on
+a fiber worker via the socket's ordered ExecutionQueue. User methods may
+complete synchronously (return a response) or keep ``done`` and call it
+later from any thread; method stats are settled exactly once either way.
+"""
+
+from __future__ import annotations
+
+import time
+
+from brpc_tpu.policy import compress as _compress
+from brpc_tpu.proto import rpc_meta_pb2
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.controller import Controller
+
+
+def process_rpc_request(protocol, msg, server) -> None:
+    meta = msg.meta
+    sock = msg.socket
+    if server is None:
+        return  # request arrived on a client-only connection: drop
+    server.requests_processed.put(1)
+    cntl = Controller.server_controller(server, sock, meta)
+
+    def send_error(code: int, text: str = "") -> None:
+        _send_response(protocol, sock, meta, code,
+                       text or errors.error_text(code),
+                       b"", b"", _compress.COMPRESS_NONE)
+
+    if not server.is_running:
+        return send_error(errors.ELOGOFF)
+    if not server.add_concurrency():
+        return send_error(errors.ELIMIT, "server max_concurrency reached")
+    start_us = time.perf_counter_ns() // 1000
+
+    # ---- admission + lookup; failures settle server concurrency here
+    err = None
+    entry = None
+    try:
+        if (server.options.auth is not None
+                and not server.options.auth.verify(meta.auth_token, sock.remote)):
+            err = (errors.EAUTH, "")
+        else:
+            service = server.find_service(meta.request.service_name)
+            if service is None:
+                err = (errors.ENOSERVICE,
+                       f"no service {meta.request.service_name!r}")
+            else:
+                entry = service.find_method(meta.request.method_name)
+                if entry is None:
+                    err = (errors.ENOMETHOD,
+                           f"no method {meta.request.method_name!r}")
+                elif not entry.on_request():
+                    entry = None
+                    err = (errors.ELIMIT, "method concurrency limit")
+    except BaseException:
+        server.sub_concurrency()
+        raise
+    if entry is None:
+        server.sub_concurrency()
+        return send_error(*err)
+    # `entry` accounting from here on settles exactly once through _settle.
+    settled = [False]
+
+    def _settle(error_code: int) -> None:
+        if settled[0]:
+            return
+        settled[0] = True
+        entry.on_response(time.perf_counter_ns() // 1000 - start_us, error_code)
+        server.sub_concurrency()
+
+    responded = [False]
+
+    def done(response=None) -> None:
+        if responded[0]:
+            return
+        responded[0] = True
+        payload_out = b""
+        if response is not None and not cntl.failed():
+            payload_out = _compress.compress(
+                response.SerializeToString(), cntl.compress_type
+            )
+        _send_response(
+            protocol, sock, meta, cntl.error_code, cntl.error_text(),
+            payload_out, cntl.response_attachment, cntl.compress_type,
+        )
+        _settle(cntl.error_code)
+
+    try:
+        payload, attachment = protocol.split_attachment(msg)
+        if not protocol.verify_checksum(meta, payload):
+            cntl.set_failed(errors.EREQUEST, "request checksum mismatch")
+            return done()
+        try:
+            data = _compress.decompress(payload, meta.compress_type)
+            request = entry.request_class()
+            request.ParseFromString(data)
+        except Exception as e:
+            cntl.set_failed(errors.EREQUEST, f"parse request: {e}")
+            return done()
+        cntl.request_attachment = attachment
+
+        # USER CODE (reference svc->CallMethod, :838-854)
+        try:
+            ret = entry.fn(cntl, request, done)
+        except Exception as e:  # user bug -> EINTERNAL, not a dead connection
+            cntl.set_failed(errors.EINTERNAL, f"method raised: {e}")
+            ret = None
+        if not responded[0] and (ret is not None or cntl.failed()):
+            done(ret)
+        # else: user code kept `done` for async completion; stats settle then
+    except BaseException:
+        _settle(errors.EINTERNAL)
+        raise
+
+
+def _send_response(protocol, sock, request_meta, code, text, payload,
+                   attachment, compress_type) -> None:
+    meta = rpc_meta_pb2.RpcMeta()
+    meta.response.error_code = code
+    if code != errors.OK:
+        meta.response.error_text = text
+    meta.correlation_id = request_meta.correlation_id
+    meta.attempt_version = request_meta.attempt_version
+    meta.compress_type = compress_type
+    # checksum responses iff the client checksummed the request
+    packet = protocol.pack_response(meta, payload, attachment or b"",
+                                    checksum=bool(request_meta.checksum))
+    sock.write(packet)

@@ -1,0 +1,237 @@
+"""Socket — the central transport object (reference socket.cpp/socket.h).
+
+Carried-over invariants (SURVEY §2.4 Socket row):
+  - Addressed by a 64-bit versioned SocketId (VersionedPool); stale ids
+    never resolve after a close/recycle (``versioned_ref_with_id.h:54``).
+  - Single-writer write path: the first writer claims the socket and writes
+    inline (the common case finishes in one syscall, ``StartWrite``
+    socket.cpp:1692); contenders append to the queue without blocking. When
+    the kernel buffer fills, the remainder drains from EPOLLOUT events (our
+    KeepWrite, socket.cpp:1800).
+  - Read events never read on the event thread beyond draining the fd into
+    the chain; message processing is handed to fiber workers in order.
+  - set_failed wakes every RPC waiting on the socket through the call-id
+    error channel, exactly once.
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import socket as _socket
+import threading
+from collections import deque
+from typing import Callable, Optional, Set
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.butil.resource_pool import VersionedPool
+from brpc_tpu.fiber import call_id as _cid
+from brpc_tpu.metrics.reducer import Adder
+from brpc_tpu.rpc import errors
+
+# process-wide socket registry: SocketId -> Socket
+_socket_pool: VersionedPool = VersionedPool()
+
+# global traffic counters (exposed later via /vars)
+g_in_bytes = Adder()
+g_out_bytes = Adder()
+
+RECV_CHUNK = 256 * 1024
+WRITE_QUEUE_MAX_BYTES = 64 * 1024 * 1024  # EOVERCROWDED beyond this
+
+
+class Socket:
+    def __init__(self, sock: _socket.socket, remote: Optional[EndPoint],
+                 dispatcher, on_readable: Optional[Callable] = None):
+        self._sock = sock
+        self.fd = sock.fileno()
+        self.remote = remote
+        self.dispatcher = dispatcher
+        self.read_buf = IOBuf()
+        self.preferred_protocol = None
+        self.failed = False
+        self.error_code = 0
+        self.error_text = ""
+        self._write_lock = threading.Lock()
+        self._write_queue: deque = deque()  # of memoryview
+        self._write_queued_bytes = 0
+        self._write_registered = False
+        self._pending_ids: Set[int] = set()
+        self._pending_lock = threading.Lock()
+        self.in_bytes = 0
+        self.out_bytes = 0
+        self.in_messages = 0
+        self.out_messages = 0
+        self.user_data = None       # server conn state, stream impl, etc.
+        self.owner_server = None    # set for accepted connections
+        self.socket_id = _socket_pool.insert(self)
+        self._on_readable = on_readable
+        self._close_lock = threading.Lock()
+
+    # --------------------------------------------------------------- factory
+    @staticmethod
+    def connect(remote: EndPoint, dispatcher, timeout: float = 3.0,
+                on_readable: Optional[Callable] = None) -> "Socket":
+        fam, addr = remote.sockaddr()
+        sock = _socket.socket(fam, _socket.SOCK_STREAM)
+        try:
+            sock.settimeout(timeout)
+            sock.connect(addr)
+        except OSError:
+            sock.close()
+            raise
+        sock.setblocking(False)
+        if fam != _socket.AF_UNIX:
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        s = Socket(sock, remote, dispatcher, on_readable=on_readable)
+        s.register_read()
+        return s
+
+    @staticmethod
+    def address(socket_id: int) -> Optional["Socket"]:
+        return _socket_pool.address(socket_id)
+
+    @staticmethod
+    def live_sockets():
+        return _socket_pool.live_objects()
+
+    def register_read(self) -> None:
+        if self._on_readable is not None:
+            self.dispatcher.add_consumer(self.fd, on_readable=self._on_readable)
+
+    # ------------------------------------------------------------ pending ids
+    def add_pending_id(self, cid: int) -> None:
+        with self._pending_lock:
+            self._pending_ids.add(cid)
+
+    def remove_pending_id(self, cid: int) -> None:
+        with self._pending_lock:
+            self._pending_ids.discard(cid)
+
+    # ------------------------------------------------------------- write path
+    def write(self, data, id_wait: Optional[int] = None) -> int:
+        """Queue bytes for sending. Returns 0 or an error code.
+
+        Never blocks: the claiming writer sends inline until EAGAIN, the
+        rest rides EPOLLOUT. id_wait (a call id) gets an error if the
+        socket dies before the bytes could matter.
+        """
+        if self.failed:
+            if id_wait is not None:
+                _cid.id_error(id_wait, errors.EFAILEDSOCKET)
+            return errors.EFAILEDSOCKET
+        if isinstance(data, IOBuf):
+            views = list(data.iter_blocks())
+            data.clear()
+        elif isinstance(data, (bytes, bytearray)):
+            views = [memoryview(bytes(data))]
+        else:
+            views = [data]
+        nbytes = sum(v.nbytes for v in views)
+        if id_wait is not None:
+            self.add_pending_id(id_wait)
+        claimed = False
+        with self._write_lock:
+            if self._write_queued_bytes > WRITE_QUEUE_MAX_BYTES:
+                if id_wait is not None:
+                    self.remove_pending_id(id_wait)
+                return errors.EOVERCROWDED
+            self._write_queue.extend(views)
+            self._write_queued_bytes += nbytes
+            if not self._write_registered:
+                # claim the writer role
+                self._write_registered = True
+                claimed = True
+        if claimed:
+            self._drain_write_queue()
+        return 0
+
+    def _drain_write_queue(self) -> None:
+        """Send until the queue empties or the kernel pushes back."""
+        while True:
+            with self._write_lock:
+                if not self._write_queue:
+                    self._write_registered = False
+                    self.dispatcher.disable_write(self.fd)
+                    return
+                head = self._write_queue[0]
+            try:
+                n = self._sock.send(head)
+            except BlockingIOError:
+                self.dispatcher.enable_write(self.fd, self._on_writable)
+                return
+            except OSError as e:
+                self.set_failed(errors.EFAILEDSOCKET, f"send: {e}")
+                return
+            self.out_bytes += n
+            g_out_bytes.put(n)
+            with self._write_lock:
+                self._write_queued_bytes -= n
+                if n == head.nbytes:
+                    self._write_queue.popleft()
+                else:
+                    self._write_queue[0] = head[n:]
+
+    def _on_writable(self) -> None:
+        self._drain_write_queue()
+
+    # -------------------------------------------------------------- read path
+    def drain_recv(self) -> int:
+        """recv until EAGAIN into read_buf; returns bytes read, -1 on EOF."""
+        total = 0
+        while True:
+            try:
+                chunk = self._sock.recv(RECV_CHUNK)
+            except BlockingIOError:
+                break
+            except OSError as e:
+                self.set_failed(errors.EFAILEDSOCKET, f"recv: {e}")
+                return -1
+            if not chunk:
+                self.set_failed(errors.EFAILEDSOCKET, "peer closed")
+                return -1
+            total += len(chunk)
+            self.in_bytes += len(chunk)
+            g_in_bytes.put(len(chunk))
+            self.read_buf.append(chunk)
+        return total
+
+    # ---------------------------------------------------------------- failure
+    def set_failed(self, code: int, reason: str = "") -> None:
+        with self._close_lock:
+            if self.failed:
+                return
+            self.failed = True
+            self.error_code = code
+            self.error_text = reason
+        try:
+            self.dispatcher.remove_consumer(self.fd)
+        except Exception:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        _socket_pool.remove(self.socket_id)
+        with self._pending_lock:
+            pending = list(self._pending_ids)
+            self._pending_ids.clear()
+        for cid in pending:
+            _cid.id_error(cid, code)
+        if self.owner_server is not None:
+            self.owner_server._on_connection_closed(self)
+
+    def close(self) -> None:
+        self.set_failed(errors.OK, "closed")
+
+    @property
+    def local_endpoint(self) -> Optional[EndPoint]:
+        try:
+            host, port = self._sock.getsockname()[:2]
+            return EndPoint.from_ip_port(host, port)
+        except OSError:
+            return None
+
+    def __repr__(self) -> str:
+        state = "failed" if self.failed else "ok"
+        return f"Socket(fd={self.fd}, remote={self.remote}, {state})"

@@ -1,0 +1,58 @@
+"""SocketMap — process-global connection sharing (reference socket_map.cpp).
+
+Channels to the same endpoint share one connection ("single" connection
+type); the map re-establishes sockets that have failed since last use.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.rpc.socket import Socket
+
+
+class SocketMap:
+    def __init__(self, dispatcher, messenger):
+        self._dispatcher = dispatcher
+        self._messenger = messenger
+        self._map: Dict[EndPoint, Socket] = {}
+        self._lock = threading.Lock()
+
+    def get_or_create(self, remote: EndPoint, connect_timeout: float = 3.0) -> Socket:
+        with self._lock:
+            sock = self._map.get(remote)
+            if sock is not None and not sock.failed:
+                return sock
+            sock = Socket.connect(remote, self._dispatcher,
+                                  timeout=connect_timeout)
+            sock._on_readable = self._messenger.make_on_readable(sock)
+            sock.register_read()
+            self._map[remote] = sock
+            return sock
+
+    def remove(self, remote: EndPoint) -> None:
+        with self._lock:
+            sock = self._map.pop(remote, None)
+        if sock is not None and not sock.failed:
+            sock.close()
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+
+_global_map: Optional[SocketMap] = None
+_global_lock = threading.Lock()
+
+
+def global_socket_map() -> SocketMap:
+    global _global_map
+    with _global_lock:
+        if _global_map is None:
+            from brpc_tpu.rpc.event_dispatcher import global_dispatcher
+            from brpc_tpu.rpc.input_messenger import InputMessenger
+
+            _global_map = SocketMap(global_dispatcher(), InputMessenger())
+        return _global_map

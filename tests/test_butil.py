@@ -153,6 +153,11 @@ class TestEndPoint:
         assert ep.kind == "tpu" and ep.mesh_axis == "tensor"
         assert str(ep) == "tpu://mesh/tensor"
 
+    def test_bare_mesh_host_is_device_endpoint(self):
+        # "tpu://mesh" (no slash) means host named "mesh", NOT axis "0"
+        ep = EndPoint.parse("tpu://mesh")
+        assert ep.mesh_axis == "" and ep.device_ordinal == 0
+
     def test_hashable(self):
         a = EndPoint.parse("1.2.3.4:5")
         b = EndPoint.parse("1.2.3.4:5")

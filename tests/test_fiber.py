@@ -1,0 +1,313 @@
+"""Fiber runtime tests (pattern: reference test/bthread_unittest.cpp,
+bthread_butex_unittest.cpp, bthread_id_unittest.cpp,
+bthread_execution_queue_unittest.cpp — real threads, real contention)."""
+
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.fiber import (
+    Butex,
+    ExecutionQueue,
+    IdGone,
+    TaskControl,
+    TimerThread,
+    id_bump_version,
+    id_create,
+    id_error,
+    id_join,
+    id_lock,
+    id_lock_verify,
+    id_unlock,
+    id_unlock_and_destroy,
+    start_background,
+    start_urgent,
+)
+
+
+class TestRuntime:
+    def test_background_runs(self):
+        hits = []
+        t = start_background(hits.append, 1)
+        assert t.join(2)
+        assert hits == [1]
+
+    def test_many_tasks_all_run(self):
+        counter = {"n": 0}
+        lock = threading.Lock()
+
+        def work():
+            with lock:
+                counter["n"] += 1
+
+        tasks = [start_background(work) for _ in range(500)]
+        for t in tasks:
+            assert t.join(5)
+        assert counter["n"] == 500
+
+    def test_task_error_captured(self):
+        def boom():
+            raise ValueError("x")
+
+        t = start_background(boom)
+        assert t.join(2)
+        assert isinstance(t.error, ValueError)
+
+    def test_urgent_ordering_hint(self):
+        # urgent tasks go to the head of a worker's queue
+        control = TaskControl(concurrency=1)
+        order = []
+        gate = threading.Event()
+        control.submit(lambda: gate.wait(2))  # block the single worker
+        control.submit(order.append, (1,))
+        control.submit(order.append, (2,), urgent=True)
+        gate.set()
+        time.sleep(0.3)
+        assert order == [2, 1]
+        control.stop()
+
+    def test_work_stealing(self):
+        control = TaskControl(concurrency=4)
+        done = threading.Event()
+        results = []
+        lock = threading.Lock()
+
+        def work(i):
+            with lock:
+                results.append(i)
+                if len(results) == 200:
+                    done.set()
+
+        for i in range(200):
+            control.submit(work, (i,))
+        assert done.wait(5)
+        control.stop()
+
+    def test_tagged_isolation(self):
+        control = TaskControl(concurrency=2)
+        seen = set()
+        lock = threading.Lock()
+
+        def work(tag):
+            with lock:
+                seen.add((tag, threading.current_thread().name.split("-")[2]))
+
+        control.submit(work, (7,), tag=7)
+        control.submit(work, (9,), tag=9)
+        time.sleep(0.3)
+        tags = {t for t, _ in seen}
+        assert tags == {"7", "9"} or {int(t) for t, _ in seen} == {7, 9}
+        control.stop()
+
+
+class TestButex:
+    def test_wait_returns_when_changed(self):
+        b = Butex(0)
+        woken = []
+
+        def waiter():
+            woken.append(b.wait(0, timeout=5))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        b.wake(value=1)
+        t.join(2)
+        assert woken == [True]
+
+    def test_no_lost_wakeup(self):
+        b = Butex(0)
+        b.set_value(1)
+        # value already differs: wait must return immediately
+        assert b.wait(0, timeout=0.01) is True
+
+    def test_timeout(self):
+        b = Butex(0)
+        assert b.wait(0, timeout=0.05) is False
+
+
+class TestTimer:
+    def test_fires(self):
+        timer = TimerThread()
+        fired = threading.Event()
+        timer.schedule(fired.set, 0.05)
+        assert fired.wait(2)
+        timer.stop()
+
+    def test_unschedule(self):
+        timer = TimerThread()
+        fired = threading.Event()
+        tid = timer.schedule(fired.set, 0.2)
+        assert timer.unschedule(tid) is True
+        assert not fired.wait(0.4)
+        timer.stop()
+
+    def test_ordering(self):
+        timer = TimerThread()
+        order = []
+        done = threading.Event()
+        timer.schedule(lambda: order.append(2), 0.10)
+        timer.schedule(lambda: (order.append(1), done.set()), 0.15)
+        timer.schedule(lambda: order.append(0), 0.05)
+        assert done.wait(2)
+        assert order == [0, 2, 1]
+        timer.stop()
+
+    def test_unschedule_fired_returns_false(self):
+        timer = TimerThread()
+        fired = threading.Event()
+        tid = timer.schedule(fired.set, 0.01)
+        assert fired.wait(2)
+        time.sleep(0.05)
+        assert timer.unschedule(tid) is False
+        timer.stop()
+
+
+class TestExecutionQueue:
+    def test_ordered_delivery(self):
+        got = []
+        done = threading.Event()
+
+        def consumer(batch):
+            if batch is None:
+                return
+            got.extend(batch)
+            if len(got) == 1000:
+                done.set()
+
+        q = ExecutionQueue(consumer)
+        for i in range(1000):
+            assert q.execute(i)
+        assert done.wait(5)
+        assert got == list(range(1000))
+
+    def test_multi_producer_ordering_per_producer(self):
+        got = []
+
+        def consumer(batch):
+            if batch:
+                got.extend(batch)
+
+        q = ExecutionQueue(consumer)
+
+        def producer(pid):
+            for i in range(200):
+                q.execute((pid, i))
+
+        ts = [threading.Thread(target=producer, args=(p,)) for p in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert q.join(5)
+        assert len(got) == 800
+        # per-producer FIFO preserved
+        for p in range(4):
+            seq = [i for (pid, i) in got if pid == p]
+            assert seq == sorted(seq)
+
+    def test_stop_delivers_none(self):
+        batches = []
+        q = ExecutionQueue(batches.append)
+        q.execute("a")
+        assert q.join(5)
+        q.stop()
+        time.sleep(0.2)
+        assert batches[-1] is None
+        assert q.execute("b") is False
+
+
+class TestCallId:
+    def test_lock_unlock_destroy_join(self):
+        cid = id_create(data={"x": 1})
+        data = id_lock(cid)
+        assert data["x"] == 1
+        id_unlock(cid)
+        id_lock(cid)
+        id_unlock_and_destroy(cid)
+        assert id_join(cid, timeout=1)
+        with pytest.raises(IdGone):
+            id_lock(cid)
+
+    def test_lock_mutual_exclusion(self):
+        cid = id_create()
+        active = {"n": 0, "max": 0}
+
+        def worker():
+            for _ in range(50):
+                id_lock(cid)
+                active["n"] += 1
+                active["max"] = max(active["max"], active["n"])
+                active["n"] -= 1
+                id_unlock(cid)
+
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert active["max"] == 1
+        id_lock(cid)
+        id_unlock_and_destroy(cid)
+
+    def test_error_when_unlocked_runs_handler(self):
+        calls = []
+
+        def on_error(data, cid, code):
+            calls.append((data, code))
+            id_unlock_and_destroy(cid)
+
+        cid = id_create(data="D", on_error=on_error)
+        assert id_error(cid, 42) is True
+        assert calls == [("D", 42)]
+        assert id_join(cid, timeout=1)
+
+    def test_error_deferred_until_unlock(self):
+        calls = []
+
+        def on_error(data, cid, code):
+            calls.append(code)
+            id_unlock_and_destroy(cid)
+
+        cid = id_create(on_error=on_error)
+        id_lock(cid)
+        assert id_error(cid, 7) is True
+        assert calls == []  # deferred: we hold the lock
+        id_unlock(cid)      # delivery happens here
+        assert calls == [7]
+
+    def test_error_after_destroy_returns_false(self):
+        cid = id_create()
+        id_lock(cid)
+        id_unlock_and_destroy(cid)
+        assert id_error(cid, 1) is False
+
+    def test_stale_version_rejected(self):
+        cid = id_create(data="payload")
+        id_lock(cid)
+        v1 = 1
+        id_bump_version(cid)  # retry issued: v2 now current
+        id_unlock(cid)
+        # response for attempt v1 arrives late:
+        with pytest.raises(IdGone):
+            id_lock_verify(cid, v1)
+        # the id itself is still lockable at the current version
+        assert id_lock_verify(cid, 2) == "payload"
+        id_unlock_and_destroy(cid)
+
+    def test_join_blocks_until_destroy(self):
+        cid = id_create()
+        done = []
+
+        def joiner():
+            done.append(id_join(cid, timeout=5))
+
+        t = threading.Thread(target=joiner)
+        t.start()
+        time.sleep(0.05)
+        assert not done
+        id_lock(cid)
+        id_unlock_and_destroy(cid)
+        t.join(2)
+        assert done == [True]

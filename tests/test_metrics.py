@@ -100,7 +100,77 @@ class TestWindow:
         assert qps.get_value() == pytest.approx(100, rel=0.5)
 
 
+class TestWindowNonInvertible:
+    def test_windowed_miner(self):
+        from brpc_tpu.metrics import Miner
+
+        col = SamplerCollector(interval_s=3600)
+        mi = Miner()
+        w = Window(mi, window_size=3, collector=col)
+        mi.put(5)
+        col.tick_all()
+        assert w.get_value() == 5  # not clamped to 0 by the empty identity
+
+    def test_windowed_maxer_negative(self):
+        from brpc_tpu.metrics import Maxer
+
+        col = SamplerCollector(interval_s=3600)
+        m = Maxer()
+        w = Window(m, window_size=3, collector=col)
+        m.put(-7)
+        col.tick_all()
+        assert w.get_value() == -7
+
+
+class TestThreadDeathRetirement:
+    def test_adder_survives_thread_death(self):
+        import gc
+
+        a = Adder()
+
+        def worker():
+            a.put(10)
+
+        for _ in range(5):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        del t
+        gc.collect()
+        assert a.get_value() == 50
+        # dead-thread agents folded into _retired, not leaked in the list
+        assert len(a._agents) <= 1
+
+    def test_percentile_survives_thread_death(self):
+        import gc
+
+        p = Percentile()
+
+        def worker():
+            for i in range(100):
+                p.put(i)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        del t
+        gc.collect()
+        assert p.get_value().count == 100
+
+
 class TestPercentile:
+    def test_count_weighted_merge(self):
+        from brpc_tpu.metrics import PercentileSamples
+
+        hot = PercentileSamples()
+        hot.add_group([100.0] * 1000, 1_000_000)  # 1M fast events
+        cold = PercentileSamples()
+        cold.add_group([5000.0] * 1000, 2_000)    # 2k slow events
+        hot.merge(cold)
+        # p50 must reflect the 500x traffic imbalance, not 50/50 samples
+        assert hot.get_number(0.5) == 100.0
+        assert hot.get_number(0.999) == 5000.0
+
     def test_basic_distribution(self):
         p = Percentile()
         for i in range(1000):

@@ -1,0 +1,294 @@
+"""RPC integration tests — client+server in one process over real loopback
+sockets, no mock transport (the reference's own pattern:
+test/brpc_channel_unittest.cpp:195 ChannelTest + fault injection via fd
+close, brpc_server_unittest.cpp full-server tests)."""
+
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.policy.compress import COMPRESS_GZIP
+from brpc_tpu.proto import echo_pb2
+from brpc_tpu.rpc import (
+    Channel,
+    ChannelOptions,
+    Controller,
+    MethodDescriptor,
+    RpcError,
+    Server,
+    ServerOptions,
+    Service,
+    Stub,
+    errors,
+)
+
+ECHO_DESC = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+
+class EchoServiceImpl(Service):
+    DESCRIPTOR = ECHO_DESC
+
+    def __init__(self):
+        super().__init__()
+        self.calls = 0
+        self.close_next_connection = False
+
+    def Echo(self, cntl, request, done):
+        self.calls += 1
+        if self.close_next_connection:
+            self.close_next_connection = False
+            # fault injection: kill the connection instead of responding
+            # (reference _close_fd_once, brpc_channel_unittest.cpp:246-250)
+            cntl._srv_socket.set_failed(errors.EFAILEDSOCKET, "test injection")
+            return None
+        if request.sleep_us:
+            time.sleep(request.sleep_us / 1e6)
+        cntl.response_attachment = cntl.request_attachment
+        return echo_pb2.EchoResponse(
+            message=request.message, payload=request.payload
+        )
+
+
+@pytest.fixture()
+def echo_server():
+    impl = EchoServiceImpl()
+    server = Server().add_service(impl).start("127.0.0.1:0")
+    yield server, impl
+    server.stop()
+    server.join(timeout=2)
+
+
+def make_stub(server, **opts):
+    ch = Channel(ChannelOptions(**opts)).init(str(server.listen_endpoint()))
+    return ch, Stub(ch, ECHO_DESC)
+
+
+class TestEcho:
+    def test_sync_echo(self, echo_server):
+        server, _ = echo_server
+        _, stub = make_stub(server)
+        resp = stub.Echo(echo_pb2.EchoRequest(message="hello"))
+        assert resp.message == "hello"
+
+    def test_async_echo(self, echo_server):
+        server, _ = echo_server
+        _, stub = make_stub(server)
+        ev = threading.Event()
+        got = []
+
+        def on_done(cntl):
+            got.append((cntl.failed(), cntl.response.message))
+            ev.set()
+
+        stub.Echo(echo_pb2.EchoRequest(message="async"), done=on_done)
+        assert ev.wait(5)
+        assert got == [(False, "async")]
+
+    def test_large_payload(self, echo_server):
+        server, _ = echo_server
+        _, stub = make_stub(server)
+        payload = bytes(range(256)) * (4 * 4096)  # 4 MB
+        resp = stub.Echo(echo_pb2.EchoRequest(message="big", payload=payload))
+        assert resp.payload == payload
+
+    def test_attachment_roundtrip(self, echo_server):
+        server, _ = echo_server
+        _, stub = make_stub(server)
+        cntl = Controller()
+        cntl.request_attachment = b"\x00\x01ATTACHMENT\xff"
+        stub.Echo(echo_pb2.EchoRequest(message="a"), controller=cntl)
+        assert cntl.response_attachment == b"\x00\x01ATTACHMENT\xff"
+
+    def test_gzip_compression(self, echo_server):
+        server, _ = echo_server
+        _, stub = make_stub(server, compress_type=COMPRESS_GZIP)
+        payload = b"z" * 100_000
+        resp = stub.Echo(echo_pb2.EchoRequest(message="gz", payload=payload))
+        assert resp.payload == payload
+
+    def test_concurrent_clients(self, echo_server):
+        server, _ = echo_server
+        _, stub = make_stub(server)
+        results = []
+        lock = threading.Lock()
+
+        def worker(n):
+            for i in range(50):
+                r = stub.Echo(echo_pb2.EchoRequest(message=f"{n}-{i}"))
+                with lock:
+                    results.append(r.message == f"{n}-{i}")
+
+        ts = [threading.Thread(target=worker, args=(n,)) for n in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(results) == 400 and all(results)
+
+    def test_two_channels_share_connection(self, echo_server):
+        server, _ = echo_server
+        ch1, stub1 = make_stub(server)
+        ch2, stub2 = make_stub(server)
+        stub1.Echo(echo_pb2.EchoRequest(message="a"))
+        stub2.Echo(echo_pb2.EchoRequest(message="b"))
+        assert server.connection_count() == 1  # SocketMap sharing
+
+
+class TestErrors:
+    def test_no_service(self, echo_server):
+        server, _ = echo_server
+        ch, _ = make_stub(server)
+        bad = MethodDescriptor("Nope", "Echo",
+                               echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+        with pytest.raises(RpcError) as ei:
+            ch.call_method(bad, echo_pb2.EchoRequest(message="x"))
+        assert ei.value.error_code == errors.ENOSERVICE
+
+    def test_no_method(self, echo_server):
+        server, _ = echo_server
+        ch, _ = make_stub(server)
+        bad = MethodDescriptor("EchoService", "Nope",
+                               echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+        with pytest.raises(RpcError) as ei:
+            ch.call_method(bad, echo_pb2.EchoRequest(message="x"))
+        assert ei.value.error_code == errors.ENOMETHOD
+
+    def test_timeout(self, echo_server):
+        server, _ = echo_server
+        _, stub = make_stub(server)
+        cntl = Controller()
+        cntl.timeout_ms = 80
+        t0 = time.monotonic()
+        with pytest.raises(RpcError) as ei:
+            stub.Echo(echo_pb2.EchoRequest(message="slow", sleep_us=400_000),
+                      controller=cntl)
+        assert ei.value.error_code == errors.ERPCTIMEDOUT
+        assert time.monotonic() - t0 < 0.3
+
+    def test_method_exception_is_einternal(self, echo_server):
+        server, impl = echo_server
+
+        def boom(cntl, request, done):
+            raise RuntimeError("kaboom")
+
+        impl.add_method("Boom", boom, echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+        ch, _ = make_stub(server)
+        bad = MethodDescriptor("EchoService", "Boom",
+                               echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+        with pytest.raises(RpcError) as ei:
+            ch.call_method(bad, echo_pb2.EchoRequest(message="x"))
+        assert ei.value.error_code == errors.EINTERNAL
+        assert "kaboom" in str(ei.value)
+
+    def test_logoff_after_stop(self, echo_server):
+        server, _ = echo_server
+        _, stub = make_stub(server)
+        stub.Echo(echo_pb2.EchoRequest(message="warm"))
+        server.stop()
+        cntl = Controller()
+        cntl.max_retry = 0  # ELOGOFF is retryable; isolate the code
+        with pytest.raises(RpcError) as ei:
+            stub.Echo(echo_pb2.EchoRequest(message="x"), controller=cntl)
+        assert ei.value.error_code == errors.ELOGOFF
+
+    def test_server_max_concurrency(self):
+        impl = EchoServiceImpl()
+        server = Server(ServerOptions(max_concurrency=1))
+        server.add_service(impl).start("127.0.0.1:0")
+        try:
+            ch = Channel().init(str(server.listen_endpoint()))
+            stub = Stub(ch, ECHO_DESC)
+            codes = []
+            lock = threading.Lock()
+
+            def call(sleep_us):
+                cntl = Controller()
+                try:
+                    stub.Echo(echo_pb2.EchoRequest(message="c", sleep_us=sleep_us),
+                              controller=cntl)
+                    code = errors.OK
+                except RpcError as e:
+                    code = e.error_code
+                with lock:
+                    codes.append(code)
+
+            t1 = threading.Thread(target=call, args=(300_000,))
+            t1.start()
+            time.sleep(0.1)  # ensure the slow call is in flight
+            call(0)
+            t1.join()
+            assert sorted(codes) == [errors.OK, errors.ELIMIT]
+        finally:
+            server.stop()
+            server.join(timeout=2)
+
+
+class TestFaultTolerance:
+    def test_retry_after_connection_close(self, echo_server):
+        server, impl = echo_server
+        _, stub = make_stub(server)
+        stub.Echo(echo_pb2.EchoRequest(message="warm"))
+        impl.close_next_connection = True
+        # connection dies mid-call; channel must retry on a fresh socket
+        resp = stub.Echo(echo_pb2.EchoRequest(message="retry-me"))
+        assert resp.message == "retry-me"
+        assert impl.calls == 3  # warm + killed attempt + successful retry
+
+    def test_no_retry_when_disabled(self, echo_server):
+        server, impl = echo_server
+        _, stub = make_stub(server)
+        stub.Echo(echo_pb2.EchoRequest(message="warm"))
+        impl.close_next_connection = True
+        cntl = Controller()
+        cntl.max_retry = 0
+        with pytest.raises(RpcError) as ei:
+            stub.Echo(echo_pb2.EchoRequest(message="x"), controller=cntl)
+        assert ei.value.error_code == errors.EFAILEDSOCKET
+
+    def test_backup_request_hedges_tail(self, echo_server):
+        server, impl = echo_server
+        _, stub = make_stub(server, backup_request_ms=50, timeout_ms=2000)
+
+        # first call sleeps, backup (same attempt version) lands after the
+        # sleep finishes server-side; both responses race, first wins.
+        slow_once = {"armed": True}
+        orig = impl.Echo
+
+        def echo_with_one_slow(cntl, request, done):
+            if slow_once["armed"]:
+                slow_once["armed"] = False
+                time.sleep(0.4)
+            return orig(cntl, request, done)
+
+        impl._methods["Echo"].fn = echo_with_one_slow
+        t0 = time.monotonic()
+        resp = stub.Echo(echo_pb2.EchoRequest(message="hedged"))
+        dt = time.monotonic() - t0
+        assert resp.message == "hedged"
+        assert dt < 0.39  # finished before the slow attempt's sleep ended
+
+    def test_connect_refused_fails_fast(self):
+        ch = Channel(ChannelOptions(max_retry=1, connect_timeout_ms=300))
+        ch.init("127.0.0.1:1")  # nothing listens there
+        stub = Stub(ch, ECHO_DESC)
+        with pytest.raises(RpcError) as ei:
+            stub.Echo(echo_pb2.EchoRequest(message="x"))
+        assert ei.value.error_code == errors.EHOSTDOWN
+
+
+class TestStats:
+    def test_method_latency_recorded(self, echo_server):
+        server, impl = echo_server
+        _, stub = make_stub(server)
+        for _ in range(10):
+            stub.Echo(echo_pb2.EchoRequest(message="m"))
+        entry = impl.find_method("Echo")
+        assert entry.latency.count() == 10
+        assert server.requests_processed.get_value() == 10
+
+    def test_channel_latency_recorded(self, echo_server):
+        server, _ = echo_server
+        ch, stub = make_stub(server)
+        stub.Echo(echo_pb2.EchoRequest(message="m"))
+        assert ch.latency_recorder.count() == 1
