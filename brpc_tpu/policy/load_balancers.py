@@ -1,0 +1,284 @@
+"""Load balancers (reference load_balancer.h:35-100 + policy/*_load_balancer
+.cpp, registered at global.cpp:384-392).
+
+Carried-over design: the server list lives in DoublyBufferedData so selection
+never locks against membership changes; ``feedback`` closes the loop for the
+locality-aware balancer (latency EWMA) and the failure tracker (consecutive
+errors park a node until its next probe — the health-check half lives in
+rpc/health_check.py).
+
+Names: rr, random, wrr, wr (weighted-random), la (locality-aware),
+c_hash (consistent hashing).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from brpc_tpu.butil.doubly_buffered import DoublyBufferedData
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.butil.misc import fast_rand_less_than
+from brpc_tpu.rpc import errors
+
+
+@dataclass
+class ServerNode:
+    endpoint: EndPoint
+    weight: int = 1
+    tag: str = ""
+
+    def __hash__(self):
+        return hash((self.endpoint, self.tag))
+
+
+class _NodeState:
+    """Per-node feedback state (latency EWMA + failure streak)."""
+
+    __slots__ = ("latency_ewma_us", "fail_streak", "down_until")
+
+    def __init__(self):
+        self.latency_ewma_us = 1000.0
+        self.fail_streak = 0
+        self.down_until = 0.0
+
+    def on_feedback(self, error_code: int, latency_us: float,
+                    isolation_s: float = 2.0) -> None:
+        if error_code == errors.OK:
+            self.fail_streak = 0
+            self.latency_ewma_us += 0.2 * (latency_us - self.latency_ewma_us)
+        else:
+            self.fail_streak += 1
+            if self.fail_streak >= 3:
+                # park the node; naming refresh / health check revives it
+                self.down_until = time.monotonic() + isolation_s
+
+    @property
+    def is_down(self) -> bool:
+        return time.monotonic() < self.down_until
+
+
+class LoadBalancer:
+    name = "base"
+
+    def __init__(self):
+        self._servers: DoublyBufferedData[List[ServerNode]] = (
+            DoublyBufferedData(list))
+        self._state: Dict[EndPoint, _NodeState] = {}
+        self._state_lock = threading.Lock()
+
+    # ---------------------------------------------------------- membership
+    def reset_servers(self, nodes: List[ServerNode]) -> None:
+        nodes = list(nodes)
+
+        def apply(lst):
+            lst.clear()
+            lst.extend(nodes)
+
+        self._servers.modify(apply)
+        with self._state_lock:
+            for n in nodes:
+                self._state.setdefault(n.endpoint, _NodeState())
+
+    def add_server(self, node: ServerNode) -> None:
+        self._servers.modify(lambda lst: lst.append(node))
+        with self._state_lock:
+            self._state.setdefault(node.endpoint, _NodeState())
+
+    def remove_server(self, endpoint: EndPoint) -> None:
+        def apply(lst):
+            lst[:] = [n for n in lst if n.endpoint != endpoint]
+
+        self._servers.modify(apply)
+
+    def server_count(self) -> int:
+        with self._servers.read() as lst:
+            return len(lst)
+
+    # ------------------------------------------------------------ feedback
+    def feedback(self, endpoint: EndPoint, error_code: int,
+                 latency_us: float) -> None:
+        with self._state_lock:
+            st = self._state.get(endpoint)
+        if st is not None:
+            st.on_feedback(error_code, latency_us)
+
+    def _node_state(self, ep: EndPoint) -> _NodeState:
+        with self._state_lock:
+            return self._state.setdefault(ep, _NodeState())
+
+    def _alive(self, nodes: List[ServerNode]) -> List[ServerNode]:
+        alive = [n for n in nodes if not self._node_state(n.endpoint).is_down]
+        return alive or list(nodes)  # all parked -> try anyway
+
+    # ------------------------------------------------------------- select
+    def select_server(self, cntl=None) -> Optional[EndPoint]:
+        raise NotImplementedError
+
+
+class RoundRobinLB(LoadBalancer):
+    name = "rr"
+
+    def __init__(self):
+        super().__init__()
+        self._counter = itertools.count()
+
+    def select_server(self, cntl=None) -> Optional[EndPoint]:
+        with self._servers.read() as lst:
+            nodes = self._alive(lst)
+            if not nodes:
+                return None
+            return nodes[next(self._counter) % len(nodes)].endpoint
+
+
+class RandomLB(LoadBalancer):
+    name = "random"
+
+    def select_server(self, cntl=None) -> Optional[EndPoint]:
+        with self._servers.read() as lst:
+            nodes = self._alive(lst)
+            if not nodes:
+                return None
+            return nodes[fast_rand_less_than(len(nodes))].endpoint
+
+
+class WeightedRoundRobinLB(LoadBalancer):
+    name = "wrr"
+
+    def __init__(self):
+        super().__init__()
+        self._lock = threading.Lock()
+        self._current: Dict[EndPoint, float] = {}
+
+    def select_server(self, cntl=None) -> Optional[EndPoint]:
+        # smooth weighted rr (nginx-style): current += weight; pick max;
+        # picked -= total
+        with self._servers.read() as lst:
+            nodes = self._alive(lst)
+            if not nodes:
+                return None
+            with self._lock:
+                total = 0
+                best, best_cur = None, float("-inf")
+                for n in nodes:
+                    w = max(1, n.weight)
+                    total += w
+                    cur = self._current.get(n.endpoint, 0.0) + w
+                    self._current[n.endpoint] = cur
+                    if cur > best_cur:
+                        best, best_cur = n, cur
+                self._current[best.endpoint] -= total
+                return best.endpoint
+
+
+class WeightedRandomLB(LoadBalancer):
+    name = "wr"
+
+    def select_server(self, cntl=None) -> Optional[EndPoint]:
+        with self._servers.read() as lst:
+            nodes = self._alive(lst)
+            if not nodes:
+                return None
+            total = sum(max(1, n.weight) for n in nodes)
+            pick = fast_rand_less_than(total)
+            acc = 0
+            for n in nodes:
+                acc += max(1, n.weight)
+                if pick < acc:
+                    return n.endpoint
+            return nodes[-1].endpoint
+
+
+class LocalityAwareLB(LoadBalancer):
+    """Latency-feedback balancer (policy/locality_aware_load_balancer.cpp):
+    selection probability ~ inverse EWMA latency, so fast replicas absorb
+    more traffic and degraded ones shed it gradually."""
+
+    name = "la"
+
+    def select_server(self, cntl=None) -> Optional[EndPoint]:
+        with self._servers.read() as lst:
+            nodes = self._alive(lst)
+            if not nodes:
+                return None
+            inv = [
+                max(1, n.weight) / max(1.0,
+                                       self._node_state(n.endpoint).latency_ewma_us)
+                for n in nodes
+            ]
+            total = sum(inv)
+            # weighted-random draw over inverse latencies
+            r = (fast_rand_less_than(1 << 30) / float(1 << 30)) * total
+            acc = 0.0
+            for n, w in zip(nodes, inv):
+                acc += w
+                if r < acc:
+                    return n.endpoint
+            return nodes[-1].endpoint
+
+
+class ConsistentHashingLB(LoadBalancer):
+    """Ketama-style ring (policy/consistent_hashing_load_balancer.cpp).
+    The request code (cntl.log_id by default) picks the ring position, so
+    one key consistently lands on one server, with minimal movement on
+    membership change."""
+
+    name = "c_hash"
+    VIRTUAL_NODES = 64
+
+    def __init__(self):
+        super().__init__()
+        self._ring_lock = threading.Lock()
+        self._ring: List[int] = []
+        self._ring_eps: List[EndPoint] = []
+
+    def reset_servers(self, nodes: List[ServerNode]) -> None:
+        super().reset_servers(nodes)
+        ring = []
+        for n in nodes:
+            for v in range(self.VIRTUAL_NODES * max(1, n.weight)):
+                h = int.from_bytes(
+                    hashlib.md5(f"{n.endpoint}#{v}".encode()).digest()[:8],
+                    "big")
+                ring.append((h, n.endpoint))
+        ring.sort(key=lambda he: he[0])
+        with self._ring_lock:
+            self._ring = [h for h, _ in ring]
+            self._ring_eps = [e for _, e in ring]
+
+    def select_server(self, cntl=None) -> Optional[EndPoint]:
+        code = getattr(cntl, "log_id", 0) if cntl is not None else 0
+        h = int.from_bytes(
+            hashlib.md5(str(code).encode()).digest()[:8], "big")
+        with self._ring_lock:
+            if not self._ring:
+                return None
+            idx = bisect.bisect(self._ring, h) % len(self._ring)
+            return self._ring_eps[idx]
+
+
+_registry: Dict[str, Callable[[], LoadBalancer]] = {
+    "rr": RoundRobinLB,
+    "random": RandomLB,
+    "wrr": WeightedRoundRobinLB,
+    "wr": WeightedRandomLB,
+    "la": LocalityAwareLB,
+    "c_hash": ConsistentHashingLB,
+}
+
+
+def register_load_balancer(name: str, factory: Callable[[], LoadBalancer]) -> None:
+    _registry[name] = factory
+
+
+def create_load_balancer(name: str) -> LoadBalancer:
+    try:
+        return _registry[name]()
+    except KeyError:
+        raise ValueError(f"unknown load balancer {name!r}; "
+                         f"have {sorted(_registry)}")

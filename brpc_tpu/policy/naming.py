@@ -1,0 +1,194 @@
+"""Naming services — cluster membership -> load balancer.
+
+Rebuild of the reference's interface (naming_service.h:36-61: RunNamingService
+pushes ResetServers), the periodic base class, and the per-url shared thread
+(details/naming_service_thread.cpp). Schemes (reference global.cpp:370-381
+has bns/file/list/http/consul/...; ours):
+
+  list://h1:p1,h2:p2 w=3     static list, optional w= weight and tag
+  file:///path               re-read periodically, one server per line
+  dns://host:port            resolve A records each refresh
+  tpu://[host]               the device mesh as a server list — one node
+                             per local chip (the TPU-native "cluster")
+
+Threads are shared per url: channels naming the same url reuse one watcher.
+"""
+
+from __future__ import annotations
+
+import os
+import socket as _socket
+import threading
+from typing import Callable, Dict, List, Optional
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.policy.load_balancers import ServerNode
+
+DEFAULT_INTERVAL_S = 5.0
+
+
+def parse_server_item(item: str) -> Optional[ServerNode]:
+    """'host:port', 'host:port w=3', 'host:port w=3 tag'."""
+    parts = item.strip().split()
+    if not parts:
+        return None
+    ep = EndPoint.parse(parts[0])
+    weight, tag = 1, ""
+    for p in parts[1:]:
+        if p.startswith("w="):
+            weight = int(p[2:])
+        else:
+            tag = p
+    return ServerNode(ep, weight=weight, tag=tag)
+
+
+class NamingService:
+    """Subclass: implement get_servers() -> List[ServerNode]."""
+
+    scheme = "base"
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def get_servers(self) -> List[ServerNode]:
+        raise NotImplementedError
+
+
+class ListNamingService(NamingService):
+    scheme = "list"
+
+    def get_servers(self) -> List[ServerNode]:
+        nodes = []
+        for item in self.path.split(","):
+            node = parse_server_item(item)
+            if node is not None:
+                nodes.append(node)
+        return nodes
+
+
+class FileNamingService(NamingService):
+    scheme = "file"
+
+    def get_servers(self) -> List[ServerNode]:
+        nodes = []
+        with open(self.path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                node = parse_server_item(line)
+                if node is not None:
+                    nodes.append(node)
+        return nodes
+
+
+class DnsNamingService(NamingService):
+    scheme = "dns"
+
+    def get_servers(self) -> List[ServerNode]:
+        host, _, port = self.path.partition(":")
+        port = int(port or 80)
+        infos = _socket.getaddrinfo(host, port, _socket.AF_INET,
+                                    _socket.SOCK_STREAM)
+        seen, nodes = set(), []
+        for _, _, _, _, addr in infos:
+            ep = EndPoint.from_ip_port(addr[0], addr[1])
+            if ep not in seen:
+                seen.add(ep)
+                nodes.append(ServerNode(ep))
+        return nodes
+
+
+class TpuNamingService(NamingService):
+    """The device mesh as a cluster: every local chip is a server."""
+
+    scheme = "tpu"
+
+    def get_servers(self) -> List[ServerNode]:
+        from brpc_tpu.tpu.mesh import list_device_endpoints
+
+        host = self.path.strip("/") or "localhost"
+        return [ServerNode(ep) for ep in list_device_endpoints(host)]
+
+
+_schemes: Dict[str, Callable[[str], NamingService]] = {
+    "list": ListNamingService,
+    "file": FileNamingService,
+    "dns": DnsNamingService,
+    "tpu": TpuNamingService,
+}
+
+
+def register_naming_service(scheme: str,
+                            factory: Callable[[str], NamingService]) -> None:
+    _schemes[scheme] = factory
+
+
+class NamingServiceThread:
+    """Periodic watcher pushing reset_servers to its listeners.
+
+    Shared per url (reference details/naming_service_thread.cpp): all
+    channels on the same url observe one refresh loop.
+    """
+
+    def __init__(self, ns: NamingService, interval_s: float):
+        self._ns = ns
+        self._interval = interval_s
+        self._listeners = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.last_servers: List[ServerNode] = []
+        self.last_error: Optional[str] = None
+        self._refresh()  # first resolution is synchronous (like Init)
+        self._thread = threading.Thread(
+            target=self._run, name=f"ns-{ns.scheme}", daemon=True)
+        self._thread.start()
+
+    def add_listener(self, lb) -> None:
+        with self._lock:
+            self._listeners.append(lb)
+            lb.reset_servers(self.last_servers)
+
+    def _refresh(self) -> None:
+        try:
+            nodes = self._ns.get_servers()
+            self.last_error = None
+        except Exception as e:
+            self.last_error = str(e)
+            return  # keep the previous list on resolution failure
+        with self._lock:
+            self.last_servers = nodes
+            listeners = list(self._listeners)
+        for lb in listeners:
+            lb.reset_servers(nodes)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self._refresh()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+_threads: Dict[str, NamingServiceThread] = {}
+_threads_lock = threading.Lock()
+
+
+def start_naming_service(url: str, lb,
+                         interval_s: float = DEFAULT_INTERVAL_S
+                         ) -> NamingServiceThread:
+    """url 'scheme://path' -> shared watcher thread feeding the lb."""
+    scheme, sep, path = url.partition("://")
+    if not sep:
+        raise ValueError(f"naming url needs scheme://, got {url!r}")
+    factory = _schemes.get(scheme)
+    if factory is None:
+        raise ValueError(f"unknown naming scheme {scheme!r}; "
+                         f"have {sorted(_schemes)}")
+    with _threads_lock:
+        thread = _threads.get(url)
+        if thread is None or thread._stop.is_set():
+            thread = NamingServiceThread(factory(path), interval_s)
+            _threads[url] = thread
+    thread.add_listener(lb)
+    return thread

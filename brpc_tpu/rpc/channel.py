@@ -103,9 +103,18 @@ class Channel:
         if cntl.compress_type == _compress.COMPRESS_NONE:
             cntl.compress_type = self.options.compress_type
         cid = cntl._begin_call(self, method, request, response, done)
-        _cid.id_lock(cid)
-        cntl._issue_rpc()
-        _cid.id_unlock(cid)
+        try:
+            _cid.id_lock(cid)
+        except _cid.IdGone:
+            pass  # a tiny timeout already fired and finished the RPC
+        else:
+            try:
+                cntl._issue_rpc()
+            finally:
+                try:  # never leave the id locked (join would hang forever)
+                    _cid.id_unlock(cid)
+                except _cid.IdGone:
+                    pass
         if done is not None:
             return cntl
         cntl.join()

@@ -158,7 +158,7 @@ class Controller:
                 self._issue_rpc()
             _cid.id_unlock(self._call_id)
             return
-        retryable = code in errors.DEFAULT_RETRYABLE and code != errors.EBACKUPREQUEST
+        retryable = code in errors.DEFAULT_RETRYABLE
         if retryable and self._retry_count < (self.max_retry or 0):
             self._retry_count += 1
             _cid.id_bump_version(self._call_id)  # stale responses now dropped

@@ -2,10 +2,12 @@
 
 Rebuild of ``input_messenger.cpp:360`` (OnNewMessages): drain the fd, loop
 cutting complete messages, remember the socket's preferred protocol after the
-first successful parse, and hand messages to fiber workers for processing —
-in per-socket order (the reference uses fresh bthreads + inline-last; we use
-a per-socket ExecutionQueue, which preserves arrival order without a
-dedicated thread, SURVEY §2.2 ExecutionQueue row).
+first successful parse, then fan processing out one fiber task per message
+(the reference's per-message bthreads). Cutting is serial per socket (the
+dispatcher thread); PROCESSING IS UNORDERED across a connection's pipelined
+messages — RPC responses are correlation-id addressed so order is
+irrelevant, and protocols that do need ordering (stream frames) re-serialize
+in their own per-stream ExecutionQueue.
 """
 
 from __future__ import annotations

@@ -2,10 +2,12 @@
 policy/baidu_rpc_protocol.cpp:565-854, and SendRpcResponse :270).
 
 Pipeline: logoff/admission checks -> service+method lookup -> attachment
-split -> checksum -> decompress+parse -> user code -> send response. Runs on
-a fiber worker via the socket's ordered ExecutionQueue. User methods may
-complete synchronously (return a response) or keep ``done`` and call it
-later from any thread; method stats are settled exactly once either way.
+split -> checksum -> decompress+parse -> user code -> send response. Each
+request runs in its own fiber task (pipelined requests on one connection
+execute concurrently and may complete out of order — responses carry the
+correlation id). User methods may complete synchronously (return a
+response) or keep ``done`` and call it later from any thread; method stats
+are settled exactly once either way.
 """
 
 from __future__ import annotations

@@ -198,6 +198,10 @@ class Socket:
 
     # ---------------------------------------------------------------- failure
     def set_failed(self, code: int, reason: str = "") -> None:
+        # a "successful" failure code would complete in-flight RPCs as bogus
+        # successes through the error channel — coerce to EFAILEDSOCKET
+        if code == errors.OK:
+            code = errors.EFAILEDSOCKET
         with self._close_lock:
             if self.failed:
                 return
@@ -222,7 +226,7 @@ class Socket:
             self.owner_server._on_connection_closed(self)
 
     def close(self) -> None:
-        self.set_failed(errors.OK, "closed")
+        self.set_failed(errors.EFAILEDSOCKET, "closed locally")
 
     @property
     def local_endpoint(self) -> Optional[EndPoint]:

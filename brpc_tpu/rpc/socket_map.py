@@ -19,22 +19,43 @@ class SocketMap:
         self._messenger = messenger
         self._map: Dict[EndPoint, Socket] = {}
         self._lock = threading.Lock()
+        # per-endpoint creation locks: a blocking connect to one dead host
+        # must not stall channels talking to healthy endpoints
+        self._create_locks: Dict[EndPoint, threading.Lock] = {}
 
     def get_or_create(self, remote: EndPoint, connect_timeout: float = 3.0) -> Socket:
         with self._lock:
             sock = self._map.get(remote)
             if sock is not None and not sock.failed:
                 return sock
+            create_lock = self._create_locks.setdefault(remote, threading.Lock())
+        with create_lock:  # serialize creation per endpoint only
+            with self._lock:
+                sock = self._map.get(remote)
+                if sock is not None and not sock.failed:
+                    return sock
             sock = Socket.connect(remote, self._dispatcher,
                                   timeout=connect_timeout)
             sock._on_readable = self._messenger.make_on_readable(sock)
             sock.register_read()
-            self._map[remote] = sock
+            with self._lock:
+                self._map[remote] = sock
             return sock
 
     def remove(self, remote: EndPoint) -> None:
         with self._lock:
-            sock = self._map.pop(remote, None)
+            create_lock = self._create_locks.get(remote)
+        if create_lock is not None:
+            # serialize against an in-flight get_or_create so a concurrent
+            # connect can't re-insert a socket right after we pop it
+            create_lock.acquire()
+        try:
+            with self._lock:
+                sock = self._map.pop(remote, None)
+                self._create_locks.pop(remote, None)  # no unbounded growth
+        finally:
+            if create_lock is not None:
+                create_lock.release()
         if sock is not None and not sock.failed:
             sock.close()
 

@@ -1,0 +1,14 @@
+"""tpu — the device data plane: TpuSocket, mesh naming, collectives, rings.
+
+Import note: importing this package does NOT import jax (cheap to import
+from the pure-RPC world); submodules pull jax in on first use.
+"""
+
+__all__ = [
+    "mesh",
+    "tpusocket",
+    "collective",
+    "ring",
+    "pallas_ops",
+    "train",
+]

@@ -1,0 +1,66 @@
+"""Pallas kernels for the hot ops (see /opt/skills/guides/pallas_guide.md).
+
+Round-1 set: fused RMSNorm (memory-bound; fusing the square/mean/scale into
+one VMEM pass saves two HBM round-trips vs the naive composition). Kernels
+run natively on TPU and in interpret mode on the CPU test substrate; both
+paths share one numerics test against the jnp reference.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-6
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def rmsnorm_reference(x, w, eps: float = EPS):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps) * w).astype(x.dtype)
+
+
+def _rmsnorm_kernel(x_ref, w_ref, o_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    o_ref[:] = (x * jax.lax.rsqrt(var + eps) * w_ref[:].astype(jnp.float32)
+                ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "interpret", "block_rows"))
+def rmsnorm(x, w, eps: float = EPS, interpret: bool = None,
+            block_rows: int = 256):
+    """Fused RMSNorm over the last dim. x: [..., D], w: [D]."""
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = not _on_tpu()
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    x2 = x.reshape(-1, D)
+    N = x2.shape[0]
+    rows = min(block_rows, N)
+    if N % rows != 0:  # pad rows to a clean grid
+        pad = rows - N % rows
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    grid = (x2.shape[0] // rows,)
+    out = pl.pallas_call(
+        functools.partial(_rmsnorm_kernel, eps=eps),
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((rows, D), lambda i: (i, 0)),
+            pl.BlockSpec((D,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((rows, D), lambda i: (i, 0)),
+        interpret=interpret,
+    )(x2, w)
+    return out[:N].reshape(orig_shape)

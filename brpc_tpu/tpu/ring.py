@@ -1,0 +1,118 @@
+"""Ring attention — sequence parallelism over the ICI ring.
+
+The long-context subsystem (the reference's closest analog is Streaming RPC's
+credit-windowed pipeline, SURVEY §5.7; here the "stream" is KV blocks
+rotating between neighbor chips). Each device owns S/n of the sequence;
+keys/values take n-1 hops around the ring (lax.ppermute) while every device
+accumulates its queries' attention over each visiting block with an online
+(flash-style) softmax — memory stays O(S/n), comm overlaps compute, and the
+result is bit-for-bit a full attention.
+
+Causal masking is handled at block granularity: a KV block strictly in the
+future contributes nothing (its exp-weights are -inf masked); the diagonal
+block applies the in-block triangular mask.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, o, m, l, mask):
+    """One online-softmax accumulation step.
+
+    q: [B, sq, H, D]   k,v: [B, sk, H, D]
+    o: [B, sq, H, D] accumulator, m/l: [B, H, sq] running max / normalizer
+    mask: [sq, sk] boolean (True = attend) or None
+    """
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask[None, None, :, :], scores, NEG_INF)
+    m_blk = jnp.max(scores, axis=-1)                      # [B,H,sq]
+    m_new = jnp.maximum(m, m_blk)
+    # guard the all-masked case (exp(NEG_INF - NEG_INF) would be exp(0))
+    alive = m_new > NEG_INF / 2
+    p = jnp.exp(scores - m_new[..., None])
+    p = jnp.where(alive[..., None], p, 0.0)
+    corr = jnp.where(alive, jnp.exp(m - m_new), 0.0)      # rescale old state
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str, causal: bool = False,
+                   batch_axis: str = None, head_axis: str = None):
+    """Attention over sequence-sharded q/k/v: [B, S, H, D] sharded on S.
+
+    Composes with data parallelism (batch_axis shards B) and tensor
+    parallelism (head_axis shards H) — attention is independent per batch
+    element and per head, so only the sequence axis communicates (KV hops).
+    Returns the same sharding. Exact (not approximate).
+    """
+    n = mesh.shape[axis]
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    spec = P(batch_axis, axis, head_axis, None)
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec)
+    def _f(q, k, v):
+        B, sq, H, D = q.shape
+        my = lax.axis_index(axis)
+        o = jnp.zeros_like(q, dtype=jnp.float32)
+        # pvary: the accumulators become varying over every sharded axis
+        # inside the loop, so their initial values must carry the same
+        # varying-axes type
+        vaxes = tuple(a for a in (batch_axis, axis, head_axis) if a)
+        m = lax.pvary(jnp.full((B, H, sq), NEG_INF, dtype=jnp.float32),
+                      vaxes)
+        l = lax.pvary(jnp.zeros((B, H, sq), dtype=jnp.float32), vaxes)
+        qf = q.astype(jnp.float32)
+
+        def step(i, carry):
+            k_cur, v_cur, o, m, l = carry
+            # the block visiting at hop i originated on device (my - i) % n
+            src = (my - i) % n
+            if causal:
+                sk = k_cur.shape[1]
+                q_pos = my * sq + jnp.arange(sq)
+                k_pos = src * sk + jnp.arange(sk)
+                mask = q_pos[:, None] >= k_pos[None, :]
+            else:
+                mask = None
+            o, m, l = _block_attend(
+                qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
+                o, m, l, mask,
+            )
+            # rotate kv to the next neighbor (overlappable with compute)
+            k_nxt = lax.ppermute(k_cur, axis, fwd)
+            v_nxt = lax.ppermute(v_cur, axis, fwd)
+            return (k_nxt, v_nxt, o, m, l)
+
+        (_, _, o, m, l) = lax.fori_loop(0, n, step, (k, v, o, m, l))
+        l_safe = jnp.where(l == 0, 1.0, l)
+        out = o / l_safe.transpose(0, 2, 1)[..., None]
+        return out.astype(q.dtype)
+
+    return _f(q, k, v)
+
+
+def full_attention_reference(q, k, v, causal: bool = False):
+    """Unsharded reference for numerics tests."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        S = q.shape[1]
+        mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)

@@ -1,0 +1,183 @@
+"""Flagship workload: a transformer LM whose distributed traffic rides the
+framework's collective layer.
+
+This is the north-star demo (BASELINE.json): "parameter-server and allreduce
+traffic carried over the framework rides XLA collectives over ICI". The
+model trains under a dp×sp×tp mesh:
+
+  dp — gradients sum over data shards (GSPMD-inserted psum = the
+       ParallelChannel 'sum' merger over the dp axis)
+  tp — attention heads + MLP width sharded; row-parallel matmuls psum over
+       tp (PartitionChannel semantics)
+  sp — sequence sharded; attention runs as ring attention (ring.py), KV
+       blocks streaming between neighbors exactly like the reference's
+       credit-windowed streams (SURVEY §5.7 mapping)
+
+Everything compiles under one jit; XLA overlaps the collectives with
+compute on ICI. Pallas RMSNorm (pallas_ops.py) is used on TPU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from brpc_tpu.tpu.pallas_ops import rmsnorm, rmsnorm_reference
+from brpc_tpu.tpu.ring import ring_attention
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab: int = 1024
+    d_model: int = 256
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 1024
+    max_seq: int = 512
+    dtype: Any = jnp.float32
+    use_pallas_norm: bool = False  # flip on for TPU runs
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(rng, cfg: ModelConfig) -> Dict:
+    keys = jax.random.split(rng, 2 + cfg.n_layers)
+    scale = cfg.d_model ** -0.5
+
+    def dense(key, shape):
+        return (jax.random.normal(key, shape) * scale).astype(cfg.dtype)
+
+    layers = []
+    for i in range(cfg.n_layers):
+        k = jax.random.split(keys[2 + i], 4)
+        layers.append({
+            "ln1": jnp.ones((cfg.d_model,), cfg.dtype),
+            "wqkv": dense(k[0], (cfg.d_model, 3 * cfg.d_model)),
+            "wo": dense(k[1], (cfg.d_model, cfg.d_model)),
+            "ln2": jnp.ones((cfg.d_model,), cfg.dtype),
+            "w1": dense(k[2], (cfg.d_model, cfg.d_ff)),
+            "w2": dense(k[3], (cfg.d_ff, cfg.d_model)),
+        })
+    return {
+        "embed": dense(keys[0], (cfg.vocab, cfg.d_model)),
+        "head": dense(keys[1], (cfg.d_model, cfg.vocab)),
+        "ln_f": jnp.ones((cfg.d_model,), cfg.dtype),
+        "layers": layers,
+    }
+
+
+def param_shardings(cfg: ModelConfig, mesh: Mesh) -> Dict:
+    """tp shards model width; everything is replicated over dp/sp."""
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    layer = {
+        "ln1": ns(), "ln2": ns(),
+        "wqkv": ns(None, "tp"),   # column-parallel: heads split over tp
+        "wo": ns("tp", None),     # row-parallel: psum over tp after matmul
+        "w1": ns(None, "tp"),
+        "w2": ns("tp", None),
+    }
+    return {
+        "embed": ns(None, "tp"),
+        "head": ns(None, "tp"),
+        "ln_f": ns(),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+def _norm(x, w, cfg: ModelConfig):
+    if cfg.use_pallas_norm:
+        return rmsnorm(x, w)
+    return rmsnorm_reference(x, w)
+
+
+def forward(params, tokens, cfg: ModelConfig, mesh: Mesh = None,
+            causal: bool = True):
+    """tokens [B, S] -> logits [B, S, V]. With a mesh, activations are
+    dp/sp-sharded and attention is ring attention over sp."""
+    B, S = tokens.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+
+    def constrain(x, *spec):
+        if mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*spec)))
+
+    x = params["embed"][tokens].astype(cfg.dtype)  # [B,S,D]
+    x = constrain(x, "dp", "sp", None)
+    for layer in params["layers"]:
+        h = _norm(x, layer["ln1"], cfg)
+        qkv = h @ layer["wqkv"]                    # [B,S,3D]
+        qkv = qkv.reshape(B, S, 3, H, Dh)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if mesh is not None:
+            q = constrain(q, "dp", "sp", "tp", None)
+            k = constrain(k, "dp", "sp", "tp", None)
+            v = constrain(v, "dp", "sp", "tp", None)
+            att = ring_attention(q, k, v, mesh, axis="sp", causal=causal,
+                                 batch_axis="dp", head_axis="tp")
+        else:
+            from brpc_tpu.tpu.ring import full_attention_reference
+
+            att = full_attention_reference(q, k, v, causal=causal)
+        att = att.reshape(B, S, cfg.d_model)
+        x = x + att @ layer["wo"]
+        x = constrain(x, "dp", "sp", None)
+        h = _norm(x, layer["ln2"], cfg)
+        x = x + jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
+        x = constrain(x, "dp", "sp", None)
+    x = _norm(x, params["ln_f"], cfg)
+    logits = x @ params["head"]
+    return constrain(logits, "dp", "sp", None)
+
+
+def loss_fn(params, batch, cfg: ModelConfig, mesh: Mesh = None):
+    tokens, targets = batch
+    logits = forward(params, tokens, cfg, mesh).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def sgd_train_step(params, batch, cfg: ModelConfig, mesh: Mesh = None,
+                   lr: float = 1e-3):
+    """One full training step (fwd+bwd+update). GSPMD inserts the dp-psum
+    for gradients and tp-psums for row-parallel matmuls automatically."""
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
+    params = jax.tree_util.tree_map(
+        lambda p, g: p - lr * g.astype(p.dtype), params, grads)
+    return params, loss
+
+
+def make_train_step(cfg: ModelConfig, mesh: Mesh, lr: float = 1e-3):
+    """Jitted sharded train step + the shardings for params and batch."""
+    pshard = param_shardings(cfg, mesh)
+    batch_shard = (
+        NamedSharding(mesh, P("dp", "sp")),
+        NamedSharding(mesh, P("dp", "sp")),
+    )
+
+    @partial(jax.jit,
+             in_shardings=(pshard, batch_shard),
+             out_shardings=(pshard, NamedSharding(mesh, P())),
+             donate_argnums=(0,))
+    def step(params, batch):
+        return sgd_train_step(params, batch, cfg, mesh, lr)
+
+    return step, pshard, batch_shard
+
+
+def demo_batch(rng, cfg: ModelConfig, batch: int, seq: int):
+    tokens = jax.random.randint(rng, (batch, seq), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+    return tokens, targets

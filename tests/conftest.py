@@ -1,8 +1,12 @@
 """Test substrate: a virtual 8-device CPU mesh (SURVEY §4 takeaway).
 
 The reference tests simulate a cluster with N channels to loopback servers;
-we likewise simulate a TPU pod with 8 virtual CPU devices via
---xla_force_host_platform_device_count, set before jax is imported anywhere.
+we likewise simulate a TPU pod with 8 virtual CPU devices.
+
+The axon sitecustomize registers the real-TPU PJRT plugin at interpreter
+start and forces jax_platforms='axon,...' via jax.config — env vars set here
+are too late. Backend *initialization* is lazy though, so overriding the
+config before any jax.devices() call still wins.
 """
 
 import os
@@ -13,6 +17,13 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+try:
+    import jax  # noqa: E402
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # non-jax environments still run the pure-RPC tests
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,210 @@
+"""Load balancer + naming service tests (reference pattern:
+test/brpc_load_balancer_unittest.cpp; cluster = channels to loopback
+servers + file/list naming, brpc_channel_unittest.cpp:211)."""
+
+import collections
+import os
+import time
+
+import pytest
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.policy.load_balancers import (
+    ConsistentHashingLB,
+    LocalityAwareLB,
+    RandomLB,
+    RoundRobinLB,
+    ServerNode,
+    WeightedRoundRobinLB,
+    create_load_balancer,
+)
+from brpc_tpu.policy.naming import (
+    ListNamingService,
+    FileNamingService,
+    parse_server_item,
+    start_naming_service,
+)
+from brpc_tpu.rpc import errors
+
+
+def nodes(*specs):
+    return [ServerNode(EndPoint.parse(s)) for s in specs]
+
+
+class TestLoadBalancers:
+    def test_rr_cycles(self):
+        lb = RoundRobinLB()
+        lb.reset_servers(nodes("127.0.0.1:1", "127.0.0.1:2", "127.0.0.1:3"))
+        picks = [str(lb.select_server()) for _ in range(6)]
+        assert picks[:3] == picks[3:6]
+        assert len(set(picks[:3])) == 3
+
+    def test_random_member(self):
+        lb = RandomLB()
+        lb.reset_servers(nodes("127.0.0.1:1", "127.0.0.1:2"))
+        for _ in range(20):
+            assert str(lb.select_server()) in {"127.0.0.1:1", "127.0.0.1:2"}
+
+    def test_empty_returns_none(self):
+        assert RoundRobinLB().select_server() is None
+
+    def test_wrr_respects_weights(self):
+        lb = WeightedRoundRobinLB()
+        lb.reset_servers([
+            ServerNode(EndPoint.parse("127.0.0.1:1"), weight=3),
+            ServerNode(EndPoint.parse("127.0.0.1:2"), weight=1),
+        ])
+        counts = collections.Counter(
+            str(lb.select_server()) for _ in range(40))
+        assert counts["127.0.0.1:1"] == 30
+        assert counts["127.0.0.1:2"] == 10
+
+    def test_la_prefers_fast(self):
+        lb = LocalityAwareLB()
+        lb.reset_servers(nodes("127.0.0.1:1", "127.0.0.1:2"))
+        fast, slow = EndPoint.parse("127.0.0.1:1"), EndPoint.parse("127.0.0.1:2")
+        for _ in range(50):
+            lb.feedback(fast, errors.OK, 100)
+            lb.feedback(slow, errors.OK, 10_000)
+        counts = collections.Counter(str(lb.select_server())
+                                     for _ in range(500))
+        assert counts["127.0.0.1:1"] > counts["127.0.0.1:2"] * 5
+
+    def test_failure_parks_node(self):
+        lb = RoundRobinLB()
+        lb.reset_servers(nodes("127.0.0.1:1", "127.0.0.1:2"))
+        bad = EndPoint.parse("127.0.0.1:2")
+        for _ in range(3):
+            lb.feedback(bad, errors.EFAILEDSOCKET, 0)
+        picks = {str(lb.select_server()) for _ in range(10)}
+        assert picks == {"127.0.0.1:1"}
+
+    def test_c_hash_sticky_and_minimal_move(self):
+        lb = ConsistentHashingLB()
+        lb.reset_servers(nodes(*[f"127.0.0.1:{p}" for p in range(1, 6)]))
+
+        class C:
+            def __init__(self, code):
+                self.log_id = code
+
+        before = {code: str(lb.select_server(C(code))) for code in range(200)}
+        # same key -> same server, always
+        for code in range(200):
+            assert str(lb.select_server(C(code))) == before[code]
+        # removing one server moves only its keys
+        lb.reset_servers(nodes(*[f"127.0.0.1:{p}" for p in range(1, 5)]))
+        moved = sum(
+            1 for code in range(200)
+            if str(lb.select_server(C(code))) != before[code])
+        assert moved < 100  # ~1/5 expected, never a full reshuffle
+
+    def test_registry(self):
+        assert create_load_balancer("rr").name == "rr"
+        with pytest.raises(ValueError):
+            create_load_balancer("nope")
+
+
+class TestNaming:
+    def test_parse_item(self):
+        n = parse_server_item("10.0.0.1:80 w=5 zoneA")
+        assert n.weight == 5 and n.tag == "zoneA"
+        assert str(n.endpoint) == "10.0.0.1:80"
+
+    def test_list_ns(self):
+        ns = ListNamingService("127.0.0.1:1,127.0.0.1:2 w=2")
+        servers = ns.get_servers()
+        assert len(servers) == 2 and servers[1].weight == 2
+
+    def test_file_ns(self, tmp_path):
+        f = tmp_path / "servers"
+        f.write_text("127.0.0.1:1\n# comment\n127.0.0.1:2 w=3\n\n")
+        ns = FileNamingService(str(f))
+        servers = ns.get_servers()
+        assert len(servers) == 2 and servers[1].weight == 3
+
+    def test_tpu_ns(self):
+        from brpc_tpu.policy.naming import TpuNamingService
+
+        servers = TpuNamingService("localhost").get_servers()
+        assert len(servers) == 8  # the virtual pod
+        assert all(s.endpoint.is_tpu() for s in servers)
+
+    def test_watcher_pushes_updates(self, tmp_path):
+        f = tmp_path / "servers"
+        f.write_text("127.0.0.1:1\n")
+        lb = RoundRobinLB()
+        thread = start_naming_service(f"file://{f}", lb, interval_s=0.1)
+        try:
+            assert lb.server_count() == 1
+            f.write_text("127.0.0.1:1\n127.0.0.1:2\n")
+            deadline = time.time() + 5
+            while lb.server_count() != 2 and time.time() < deadline:
+                time.sleep(0.05)
+            assert lb.server_count() == 2
+        finally:
+            thread.stop()
+
+    def test_unknown_scheme(self):
+        with pytest.raises(ValueError):
+            start_naming_service("zk://x", RoundRobinLB())
+
+
+class TestChannelWithLB:
+    def test_rr_over_two_loopback_servers(self):
+        """The reference's multi-node simulation: N real servers on loopback
+        behind a list:// naming service (brpc_channel_unittest.cpp:211)."""
+        from brpc_tpu.proto import echo_pb2
+        from brpc_tpu.rpc import Channel, Server, Service, Stub
+
+        class Impl(Service):
+            DESCRIPTOR = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+            def __init__(self, name):
+                super().__init__()
+                self.name = name
+                self.hits = 0
+
+            def Echo(self, cntl, request, done):
+                self.hits += 1
+                return echo_pb2.EchoResponse(message=self.name)
+
+        impls = [Impl("s1"), Impl("s2")]
+        servers = [Server().add_service(i).start("127.0.0.1:0")
+                   for i in impls]
+        try:
+            url = "list://" + ",".join(
+                str(s.listen_endpoint()) for s in servers)
+            ch = Channel().init(url, "rr")
+            stub = Stub(ch, echo_pb2.DESCRIPTOR.services_by_name["EchoService"])
+            got = {stub.Echo(echo_pb2.EchoRequest(message="x")).message
+                   for _ in range(10)}
+            assert got == {"s1", "s2"}
+            assert impls[0].hits == 5 and impls[1].hits == 5
+        finally:
+            for s in servers:
+                s.stop()
+                s.join(timeout=2)
+
+    def test_failover_to_healthy_server(self):
+        from brpc_tpu.proto import echo_pb2
+        from brpc_tpu.rpc import Channel, ChannelOptions, Server, Service, Stub
+
+        class Impl(Service):
+            DESCRIPTOR = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+            def Echo(self, cntl, request, done):
+                return echo_pb2.EchoResponse(message="alive")
+
+        server = Server().add_service(Impl()).start("127.0.0.1:0")
+        try:
+            # one dead endpoint + one live one
+            url = f"list://127.0.0.1:1,{server.listen_endpoint()}"
+            ch = Channel(ChannelOptions(max_retry=3,
+                                        connect_timeout_ms=200)).init(url, "rr")
+            stub = Stub(ch, echo_pb2.DESCRIPTOR.services_by_name["EchoService"])
+            for _ in range(4):
+                assert stub.Echo(
+                    echo_pb2.EchoRequest(message="x")).message == "alive"
+        finally:
+            server.stop()
+            server.join(timeout=2)
