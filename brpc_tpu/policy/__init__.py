@@ -20,6 +20,8 @@ def ensure_registered() -> None:
             return
         from brpc_tpu.rpc.protocol import register_protocol
         from brpc_tpu.policy.trpc_std import TrpcStdProtocol
+        from brpc_tpu.policy.trpc_stream import TrpcStreamProtocol
 
         register_protocol(TrpcStdProtocol())
+        register_protocol(TrpcStreamProtocol())
         _done = True

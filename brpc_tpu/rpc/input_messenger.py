@@ -57,7 +57,12 @@ class InputMessenger:
             msg.socket = sock
             sock.in_messages += 1
             count += 1
-            runtime.start_background(_process_one, msg, server)
+            if msg.protocol.inline_process:
+                # order-sensitive frames (streams): handle on the serial
+                # parse loop; the handler only enqueues to per-stream queues
+                _process_one(msg, server)
+            else:
+                runtime.start_background(_process_one, msg, server)
         return count
 
     def _cut_one(self, sock: Socket) -> Optional[ParsedMessage]:
@@ -85,11 +90,6 @@ class InputMessenger:
 
 def _process_one(msg, server) -> None:
     try:
-        if msg.meta.HasField("request"):
-            msg.protocol.process_request(
-                msg, server or msg.socket.owner_server
-            )
-        else:
-            msg.protocol.process_response(msg)
+        msg.protocol.process(msg, server or msg.socket.owner_server)
     except Exception:
         pass

@@ -40,6 +40,11 @@ class Protocol:
     name = "base"
     # protocols whose first bytes are a fixed magic can be probed cheaply
     magic: Optional[bytes] = None
+    # True: process() runs inline on the parse loop (serial per socket).
+    # Frame protocols that depend on arrival order need this — fanning out
+    # to fiber tasks first would lose ordering before any downstream queue
+    # can restore it. Inline handlers must be cheap/non-blocking.
+    inline_process = False
 
     def parse(self, buf: IOBuf) -> Tuple[int, Optional[ParsedMessage]]:
         """Try to cut ONE message from buf. Returns (PARSE_*, msg|None)."""
@@ -56,6 +61,14 @@ class Protocol:
 
     def process_response(self, msg: ParsedMessage) -> None:
         raise NotImplementedError
+
+    def process(self, msg: ParsedMessage, server) -> None:
+        """Route one parsed message. RPC protocols split request/response by
+        meta; frame protocols (streams) override entirely."""
+        if msg.meta.HasField("request"):
+            self.process_request(msg, server)
+        else:
+            self.process_response(msg)
 
 
 _protocols: List[Protocol] = []

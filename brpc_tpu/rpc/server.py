@@ -74,11 +74,26 @@ class MethodEntry:
     latency: LatencyRecorder = field(default_factory=LatencyRecorder)
     errors_count: Adder = field(default_factory=Adder)
     current_concurrency: int = 0
-    max_concurrency: int = 0  # 0 = unlimited; limiter hooks attach here
+    max_concurrency: int = 0  # 0 = unlimited (shorthand for a constant limiter)
+    limiter: object = None    # policy/limiters.py ConcurrencyLimiter
     _conc_lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def set_limiter(self, spec) -> "MethodEntry":
+        """spec: int | 'constant:N' | 'auto' | 'timeout[:ms]'
+        (reference adaptive_max_concurrency.h string forms)."""
+        from brpc_tpu.policy.limiters import create_limiter
+
+        self.limiter = create_limiter(spec)
+        return self
 
     def on_request(self) -> bool:
         """Admission check; False -> ELIMIT."""
+        if self.limiter is not None:
+            ok = self.limiter.on_request()
+            if ok:
+                with self._conc_lock:
+                    self.current_concurrency += 1
+            return ok
         with self._conc_lock:
             if self.max_concurrency and self.current_concurrency >= self.max_concurrency:
                 return False
@@ -88,6 +103,8 @@ class MethodEntry:
     def on_response(self, latency_us: float, error_code: int) -> None:
         with self._conc_lock:
             self.current_concurrency -= 1
+        if self.limiter is not None:
+            self.limiter.on_response(latency_us, error_code)
         self.latency.record(latency_us)
         if error_code != errors.OK:
             self.errors_count.put(1)

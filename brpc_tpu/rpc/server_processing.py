@@ -86,9 +86,18 @@ def process_rpc_request(protocol, msg, server) -> None:
             payload_out = _compress.compress(
                 response.SerializeToString(), cntl.compress_type
             )
+        accepted = cntl._accepted_stream_id
+        if accepted and cntl.failed():
+            # the client will never bind to a failed RPC's stream — reclaim
+            # it instead of leaking it in the pool holding the socket
+            from brpc_tpu.rpc.stream import stream_close
+
+            stream_close(accepted)
+            accepted = 0
         _send_response(
             protocol, sock, meta, cntl.error_code, cntl.error_text(),
             payload_out, cntl.response_attachment, cntl.compress_type,
+            accepted_stream_id=accepted,
         )
         _settle(cntl.error_code)
 
@@ -121,7 +130,8 @@ def process_rpc_request(protocol, msg, server) -> None:
 
 
 def _send_response(protocol, sock, request_meta, code, text, payload,
-                   attachment, compress_type) -> None:
+                   attachment, compress_type,
+                   accepted_stream_id: int = 0) -> None:
     meta = rpc_meta_pb2.RpcMeta()
     meta.response.error_code = code
     if code != errors.OK:
@@ -129,6 +139,13 @@ def _send_response(protocol, sock, request_meta, code, text, payload,
     meta.correlation_id = request_meta.correlation_id
     meta.attempt_version = request_meta.attempt_version
     meta.compress_type = compress_type
+    if accepted_stream_id:
+        from brpc_tpu.rpc.stream import get_stream
+
+        meta.stream_settings.stream_id = accepted_stream_id
+        accepted = get_stream(accepted_stream_id)
+        if accepted is not None:  # tell the client our writer window
+            meta.stream_settings.window_bytes = accepted.options.window_bytes
     # checksum responses iff the client checksummed the request
     packet = protocol.pack_response(meta, payload, attachment or b"",
                                     checksum=bool(request_meta.checksum))

@@ -1,0 +1,338 @@
+"""Combo channel + admission/failure-policy tests (reference pattern:
+brpc_channel_unittest.cpp:395-430 — N sub-channels to loopback servers)."""
+
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.proto import echo_pb2
+from brpc_tpu.rpc import (
+    Channel,
+    ChannelOptions,
+    Controller,
+    MethodDescriptor,
+    RpcError,
+    Server,
+    Service,
+    Stub,
+    errors,
+)
+from brpc_tpu.rpc.combo_channels import (
+    CallMapper,
+    ParallelChannel,
+    PartitionChannel,
+    ResponseMerger,
+    SelectiveChannel,
+    SKIP,
+    SubCall,
+)
+
+ECHO_DESC = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+ECHO_MD = MethodDescriptor("EchoService", "Echo",
+                           echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+
+
+class NamedEcho(Service):
+    DESCRIPTOR = ECHO_DESC
+
+    def __init__(self, name, fail=False):
+        super().__init__()
+        self.name = name
+        self.fail = fail
+        self.hits = 0
+
+    def Echo(self, cntl, request, done):
+        self.hits += 1
+        if self.fail:
+            raise RuntimeError("injected")
+        return echo_pb2.EchoResponse(message=self.name)
+
+
+def start_servers(*impls):
+    servers = [Server().add_service(i).start("127.0.0.1:0") for i in impls]
+    return servers
+
+
+def stop_servers(servers):
+    for s in servers:
+        s.stop()
+        s.join(timeout=2)
+
+
+class TestParallelChannel:
+    def test_fanout_and_merge(self):
+        impls = [NamedEcho("a"), NamedEcho("b"), NamedEcho("c")]
+        servers = start_servers(*impls)
+        try:
+            pc = ParallelChannel()
+            for s in servers:
+                pc.add_channel(Channel().init(str(s.listen_endpoint())))
+
+            class ConcatMerger(ResponseMerger):
+                def merge(self, response, sub):
+                    response.message += sub.message
+                    return 0
+
+            pc2 = ParallelChannel()
+            for s in servers:
+                pc2.add_channel(Channel().init(str(s.listen_endpoint())),
+                                response_merger=ConcatMerger())
+            resp = pc2.call_method(ECHO_MD, echo_pb2.EchoRequest(message="x"))
+            assert sorted(resp.message) == ["a", "b", "c"]
+            assert all(i.hits == 1 for i in impls)
+        finally:
+            stop_servers(servers)
+
+    def test_fail_limit(self):
+        impls = [NamedEcho("ok"), NamedEcho("bad", fail=True)]
+        servers = start_servers(*impls)
+        try:
+            pc = ParallelChannel(fail_limit=1)
+            for s in servers:
+                pc.add_channel(Channel().init(str(s.listen_endpoint())))
+            with pytest.raises(RpcError) as ei:
+                pc.call_method(ECHO_MD, echo_pb2.EchoRequest(message="x"))
+            assert ei.value.error_code == errors.ETOOMANYFAILS
+        finally:
+            stop_servers(servers)
+
+    def test_partial_failure_tolerated_by_default(self):
+        impls = [NamedEcho("ok"), NamedEcho("bad", fail=True)]
+        servers = start_servers(*impls)
+        try:
+            pc = ParallelChannel()  # default: succeed unless ALL fail
+            for s in servers:
+                pc.add_channel(Channel().init(str(s.listen_endpoint())))
+            resp = pc.call_method(ECHO_MD, echo_pb2.EchoRequest(message="x"))
+            assert resp.message == "ok"
+        finally:
+            stop_servers(servers)
+
+    def test_call_mapper_skip_and_rewrite(self):
+        impls = [NamedEcho("a"), NamedEcho("b")]
+        servers = start_servers(*impls)
+        try:
+            class OnlyFirst(CallMapper):
+                def map(self, idx, method, request, response):
+                    if idx != 0:
+                        return SKIP
+                    return SubCall(method,
+                                   echo_pb2.EchoRequest(message="rewritten"),
+                                   echo_pb2.EchoResponse())
+
+            pc = ParallelChannel()
+            for s in servers:
+                pc.add_channel(Channel().init(str(s.listen_endpoint())),
+                               call_mapper=OnlyFirst())
+            resp = pc.call_method(ECHO_MD, echo_pb2.EchoRequest(message="x"))
+            assert resp.message == "a"
+            assert impls[0].hits == 1 and impls[1].hits == 0
+        finally:
+            stop_servers(servers)
+
+    def test_async_done(self):
+        impls = [NamedEcho("a")]
+        servers = start_servers(*impls)
+        try:
+            pc = ParallelChannel()
+            pc.add_channel(Channel().init(str(servers[0].listen_endpoint())))
+            ev = threading.Event()
+            out = []
+
+            def on_done(cntl):
+                out.append(cntl.failed())
+                ev.set()
+
+            pc.call_method(ECHO_MD, echo_pb2.EchoRequest(message="x"),
+                           done=on_done)
+            assert ev.wait(5)
+            assert out == [False]
+        finally:
+            stop_servers(servers)
+
+
+class TestSelectiveChannel:
+    def test_prefers_healthy_channel(self):
+        impls = [NamedEcho("good")]
+        servers = start_servers(*impls)
+        try:
+            sc = SelectiveChannel()
+            dead = Channel(ChannelOptions(max_retry=0,
+                                          connect_timeout_ms=200))
+            dead.init("127.0.0.1:1")
+            sc.add_channel(dead)
+            sc.add_channel(Channel().init(str(servers[0].listen_endpoint())))
+            for _ in range(4):
+                resp = sc.call_method(ECHO_MD,
+                                      echo_pb2.EchoRequest(message="x"))
+                assert resp.message == "good"
+            # dead channel parked after its failures: traffic converges
+            assert impls[0].hits >= 4
+        finally:
+            stop_servers(servers)
+
+    def test_all_dead_fails(self):
+        sc = SelectiveChannel(max_retry=1)
+        dead = Channel(ChannelOptions(max_retry=0, connect_timeout_ms=100))
+        dead.init("127.0.0.1:1")
+        sc.add_channel(dead)
+        with pytest.raises(RpcError):
+            sc.call_method(ECHO_MD, echo_pb2.EchoRequest(message="x"))
+
+
+class TestPartitionChannel:
+    def test_partitioned_fanout(self):
+        impls = [NamedEcho("p0"), NamedEcho("p1")]
+        servers = start_servers(*impls)
+        try:
+            url = (f"list://{servers[0].listen_endpoint()} 0/2,"
+                   f"{servers[1].listen_endpoint()} 1/2")
+
+            class ConcatMerger(ResponseMerger):
+                def merge(self, response, sub):
+                    response.message += sub.message
+                    return 0
+
+            pc = PartitionChannel()
+            pc.init(url, partition_count=2)
+            # swap default mergers for concat to observe both partitions
+            pc._subs = [(ch, m, ConcatMerger()) for ch, m, _ in pc._subs]
+            resp = pc.call_method(ECHO_MD, echo_pb2.EchoRequest(message="x"))
+            assert sorted(resp.message.split("p")[1:]) == ["0", "1"]
+            assert impls[0].hits == 1 and impls[1].hits == 1
+        finally:
+            stop_servers(servers)
+
+    def test_wrong_partition_count_dropped(self):
+        from brpc_tpu.rpc.combo_channels import PartitionParser
+
+        parser = PartitionParser()
+        assert parser.parse("1/3") == (1, 3)
+        assert parser.parse("junk") is None
+
+
+class TestLimiters:
+    def test_constant(self):
+        from brpc_tpu.policy.limiters import ConstantLimiter
+
+        lim = ConstantLimiter(2)
+        assert lim.on_request() and lim.on_request()
+        assert not lim.on_request()
+        lim.on_response(100, 0)
+        assert lim.on_request()
+
+    def test_auto_grows_on_healthy_latency(self):
+        from brpc_tpu.policy.limiters import AutoLimiter
+
+        lim = AutoLimiter(initial=8, sample_window=16)
+        for _ in range(200):
+            if lim.on_request():
+                lim.on_response(100.0, 0)
+        assert lim.limit > 8  # stable latency -> limit grows
+
+    def test_auto_shrinks_on_degraded_latency(self):
+        from brpc_tpu.policy.limiters import AutoLimiter
+
+        lim = AutoLimiter(initial=64, sample_window=16)
+        for _ in range(32):  # establish a fast floor
+            lim.on_request()
+            lim.on_response(100.0, 0)
+        for _ in range(200):  # latency collapses
+            if lim.on_request():
+                lim.on_response(10_000.0, 0)
+        assert lim.limit < 64
+
+    def test_timeout_limiter_rejects_when_backlogged(self):
+        from brpc_tpu.policy.limiters import TimeoutLimiter
+
+        lim = TimeoutLimiter(timeout_ms=1.0)
+        lim._avg_latency_us = 10_000.0  # 10ms per request observed
+        assert lim.on_request()  # queue empty: expected wait 0
+        assert not lim.on_request()  # one queued x 10ms > 1ms budget
+
+    def test_method_limiter_wireup(self):
+        impl = NamedEcho("x")
+        server = Server().add_service(impl).start("127.0.0.1:0")
+        try:
+            impl.find_method("Echo").set_limiter("constant:1")
+            ch = Channel().init(str(server.listen_endpoint()))
+            stub = Stub(ch, ECHO_DESC)
+            assert stub.Echo(echo_pb2.EchoRequest(message="m")).message == "x"
+        finally:
+            server.stop()
+            server.join(timeout=2)
+
+
+class TestCircuitBreaker:
+    def test_trips_on_error_burst_and_recovers(self):
+        from brpc_tpu.rpc.circuit_breaker import CircuitBreaker
+
+        cb = CircuitBreaker(min_samples=10, base_isolation_s=0.05)
+        for _ in range(20):
+            cb.on_call_end(1)  # all errors
+        assert cb.isolated
+        time.sleep(0.08)
+        assert not cb.isolated  # isolation expired: half-open
+
+    def test_healthy_traffic_never_trips(self):
+        from brpc_tpu.rpc.circuit_breaker import CircuitBreaker
+
+        cb = CircuitBreaker()
+        for _ in range(1000):
+            cb.on_call_end(0)
+        assert not cb.isolated
+
+    def test_repeat_offender_isolated_longer(self):
+        from brpc_tpu.rpc.circuit_breaker import CircuitBreaker
+
+        cb = CircuitBreaker(min_samples=5, base_isolation_s=0.02)
+        for _ in range(10):
+            cb.on_call_end(1)
+        first = cb._isolated_until - time.monotonic()
+        time.sleep(0.03)
+        for _ in range(10):
+            cb.on_call_end(1)
+        second = cb._isolated_until - time.monotonic()
+        assert second > first
+
+    def test_cluster_recover_guard(self):
+        from brpc_tpu.rpc.circuit_breaker import ClusterRecoverGuard
+
+        g = ClusterRecoverGuard(threshold=0.5, interval_s=10)
+        assert g.may_recover(1, 10)       # few isolated: free recovery
+        assert g.may_recover(8, 10)       # mass isolation: first allowed
+        assert not g.may_recover(8, 10)   # second rationed
+
+
+class TestHealthCheck:
+    def test_probe_revives_parked_node(self):
+        from brpc_tpu.butil.endpoint import EndPoint
+        from brpc_tpu.policy.load_balancers import RoundRobinLB, ServerNode
+        from brpc_tpu.rpc.health_check import HealthChecker
+
+        impl = NamedEcho("alive")
+        server = Server().add_service(impl).start("127.0.0.1:0")
+        try:
+            ep = server.listen_endpoint()
+            lb = RoundRobinLB()
+            lb.reset_servers([ServerNode(ep)])
+            # park it artificially
+            st = lb._node_state(ep)
+            st.fail_streak = 3
+            st.down_until = time.monotonic() + 60
+            checker = HealthChecker(lb, interval_s=0.05)
+            deadline = time.time() + 5
+            while st.is_down and time.time() < deadline:
+                time.sleep(0.05)
+            assert not st.is_down
+            checker.stop()
+        finally:
+            server.stop()
+            server.join(timeout=2)
+
+    def test_tcp_probe_dead_endpoint(self):
+        from brpc_tpu.butil.endpoint import EndPoint
+        from brpc_tpu.rpc.health_check import tcp_probe
+
+        assert tcp_probe(EndPoint.parse("127.0.0.1:1"), timeout=0.3) is False

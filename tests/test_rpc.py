@@ -284,6 +284,10 @@ class TestStats:
         for _ in range(10):
             stub.Echo(echo_pb2.EchoRequest(message="m"))
         entry = impl.find_method("Echo")
+        # stats settle server-side after the response is written: poll
+        deadline = time.monotonic() + 2
+        while entry.latency.count() != 10 and time.monotonic() < deadline:
+            time.sleep(0.01)
         assert entry.latency.count() == 10
         assert server.requests_processed.get_value() == 10
 

@@ -1,0 +1,202 @@
+"""Streaming RPC tests (reference test/brpc_streaming_rpc_unittest.cpp
+pattern: client+server streams over loopback, flow-control pressure)."""
+
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.proto import echo_pb2
+from brpc_tpu.rpc import Channel, Controller, Server, Service, Stub, errors
+from brpc_tpu.rpc.stream import (
+    StreamOptions,
+    get_stream,
+    stream_accept,
+    stream_close,
+    stream_create,
+    stream_write,
+)
+
+ECHO_DESC = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+
+class StreamingEchoService(Service):
+    """Accepts a stream and echoes every received message back on it."""
+
+    DESCRIPTOR = ECHO_DESC
+
+    def __init__(self):
+        super().__init__()
+        self.server_streams = []
+        self.received = []
+        self.closed = threading.Event()
+
+    def Echo(self, cntl, request, done):
+        def on_received(sid, msgs):
+            self.received.extend(msgs)
+            for m in msgs:
+                stream_write(sid, m)  # echo back on the same stream
+
+        def on_closed(sid):
+            self.closed.set()
+
+        sid = stream_accept(cntl, StreamOptions(
+            on_received=on_received, on_closed=on_closed))
+        self.server_streams.append(sid)
+        return echo_pb2.EchoResponse(message="stream-accepted")
+
+
+@pytest.fixture()
+def stream_server():
+    impl = StreamingEchoService()
+    server = Server().add_service(impl).start("127.0.0.1:0")
+    yield server, impl
+    server.stop()
+    server.join(timeout=2)
+
+
+def connect_stream(server, on_received=None, on_closed=None, window=None):
+    opts = StreamOptions(on_received=on_received, on_closed=on_closed)
+    if window:
+        opts.window_bytes = window
+    sid = stream_create(opts)
+    cntl = Controller()
+    cntl.stream_id = sid
+    ch = Channel().init(str(server.listen_endpoint()))
+    stub = Stub(ch, ECHO_DESC)
+    resp = stub.Echo(echo_pb2.EchoRequest(message="open"), controller=cntl)
+    assert resp.message == "stream-accepted"
+    return sid
+
+
+class TestStreaming:
+    def test_echo_roundtrip(self, stream_server):
+        server, impl = stream_server
+        got = []
+        done = threading.Event()
+
+        def on_received(sid, msgs):
+            got.extend(msgs)
+            if len(got) >= 3:
+                done.set()
+
+        sid = connect_stream(server, on_received)
+        for i in range(3):
+            assert stream_write(sid, f"msg-{i}".encode()) == 0
+        assert done.wait(5)
+        assert got == [b"msg-0", b"msg-1", b"msg-2"]
+        assert impl.received == got
+
+    def test_ordering_under_load(self, stream_server):
+        server, impl = stream_server
+        got = []
+        done = threading.Event()
+        N = 500
+
+        def on_received(sid, msgs):
+            got.extend(msgs)
+            if len(got) >= N:
+                done.set()
+
+        sid = connect_stream(server, on_received)
+        for i in range(N):
+            assert stream_write(sid, str(i).encode().zfill(6)) == 0
+        assert done.wait(15)
+        assert got == [str(i).encode().zfill(6) for i in range(N)]
+
+    def test_flow_control_blocks_and_recovers(self, stream_server):
+        """Writer must stall when the window fills and resume on feedback
+        (stream.cpp:318 AppendIfNotFull / :354 SetRemoteConsumed)."""
+        server, impl = stream_server
+        window = 64 * 1024
+        got = []
+        done = threading.Event()
+        total = 32
+
+        def on_received(sid, msgs):
+            got.extend(msgs)
+            if len(got) >= total:
+                done.set()
+
+        sid = connect_stream(server, on_received, window=window)
+        chunk = b"z" * (16 * 1024)
+        t0 = time.monotonic()
+        for _ in range(total):  # 512KB through a 64KB window
+            assert stream_write(sid, chunk, timeout=10) == 0
+        assert done.wait(15)
+        assert len(got) == total
+        stream = get_stream(sid)
+        # feedback advanced the window: remote_consumed caught up
+        assert stream._remote_consumed > 0
+
+    def test_nonblocking_write_overcrowded(self):
+        """Deterministic: the server's consumer is gated shut, so no
+        FEEDBACK can race in and free the window between the two writes."""
+        gate = threading.Event()
+
+        class Gated(Service):
+            DESCRIPTOR = ECHO_DESC
+
+            def Echo(self, cntl, request, done):
+                stream_accept(cntl, StreamOptions(
+                    on_received=lambda sid, msgs: gate.wait(5)))
+                return echo_pb2.EchoResponse(message="ok")
+
+        server = Server().add_service(Gated()).start("127.0.0.1:0")
+        try:
+            opts = StreamOptions(blocking_write=False, window_bytes=1024)
+            sid = stream_create(opts)
+            cntl = Controller()
+            cntl.stream_id = sid
+            ch = Channel().init(str(server.listen_endpoint()))
+            stub = Stub(ch, ECHO_DESC)
+            stub.Echo(echo_pb2.EchoRequest(message="open"), controller=cntl)
+            big = b"x" * 900
+            assert stream_write(sid, big) == 0
+            rc = stream_write(sid, big)  # would exceed 1024-byte window
+            assert rc == errors.EOVERCROWDED
+        finally:
+            gate.set()
+            server.stop()
+            server.join(timeout=2)
+
+    def test_close_propagates(self, stream_server):
+        server, impl = stream_server
+        client_closed = threading.Event()
+        sid = connect_stream(server,
+                             on_closed=lambda s: client_closed.set())
+        stream_close(sid)
+        assert impl.closed.wait(5)  # server saw the CLOSE frame
+        assert client_closed.wait(5)
+        assert stream_write(sid, b"late") == errors.ESTREAMCLOSED
+
+    def test_write_to_unknown_stream(self):
+        assert stream_write(999 << 32, b"x") == errors.ESTREAMCLOSED
+
+    def test_accept_without_settings_raises(self, stream_server):
+        server, impl = stream_server
+
+        class NoStream(Service):
+            DESCRIPTOR = ECHO_DESC
+
+            def __init__(self):
+                super().__init__()
+                self.error = None
+
+            def Echo(self, cntl, request, done):
+                try:
+                    stream_accept(cntl)
+                except ValueError as e:
+                    self.error = e
+                return echo_pb2.EchoResponse(message="no")
+
+        impl2 = NoStream()
+        server2 = Server().add_service(impl2).start("127.0.0.1:0")
+        try:
+            ch = Channel().init(str(server2.listen_endpoint()))
+            stub = Stub(ch, ECHO_DESC)
+            stub.Echo(echo_pb2.EchoRequest(message="plain"))
+            assert impl2.error is not None
+        finally:
+            server2.stop()
+            server2.join(timeout=2)
