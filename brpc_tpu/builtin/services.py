@@ -1,0 +1,274 @@
+"""The builtin service handlers (reference src/brpc/builtin/*).
+
+Each handler renders plain text (curl-friendly) unless the client is a
+browser asking for HTML (the reference's use_html sniffing via the
+User-Agent). Registered into the brpc_tpu.builtin registry at import.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+
+import brpc_tpu
+from brpc_tpu import flags as _flags
+from brpc_tpu.builtin import register_builtin
+from brpc_tpu.metrics import dump_exposed, prometheus_text
+from brpc_tpu.policy.http_protocol import (
+    CONTENT_HTML,
+    CONTENT_JSON,
+    CONTENT_TEXT,
+    HttpMessage,
+)
+
+_start_time = time.time()
+
+
+def _wants_html(http: HttpMessage) -> bool:
+    return "text/html" in http.header("accept", "")
+
+
+def _sub_path(http: HttpMessage) -> str:
+    parts = http.path.strip("/").split("/", 1)
+    return parts[1] if len(parts) > 1 else ""
+
+
+# ---------------------------------------------------------------------- index
+def index_service(server, http: HttpMessage):
+    from brpc_tpu.builtin import list_builtin
+
+    if _wants_html(http):
+        rows = "".join(
+            f'<li><a href="/{s.name}">/{s.name}</a> — {s.help}</li>'
+            for s in list_builtin())
+        body = (f"<html><head><title>brpc_tpu</title></head><body>"
+                f"<h1>brpc_tpu {brpc_tpu.__version__}</h1><ul>{rows}</ul>"
+                f"</body></html>")
+        return 200, CONTENT_HTML, body
+    lines = [f"/{s.name:<16} {s.help}" for s in list_builtin()]
+    return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------------------------- status
+def status_service(server, http: HttpMessage):
+    out = [f"version: {brpc_tpu.__version__}",
+           f"uptime_s: {time.time() - _start_time:.0f}"]
+    if server is not None:
+        ep = server.listen_endpoint()
+        out += [f"listen: {ep}",
+                f"connections: {server.connection_count()}",
+                f"concurrency: {server.concurrency}",
+                f"requests_processed: {server.requests_processed.get_value()}"]
+        for sname, svc in sorted(server.services.items()):
+            out.append(f"\n[{sname}]")
+            for mname, entry in sorted(svc._methods.items()):
+                lr = entry.latency
+                out.append(
+                    f"  {mname}: count={lr.count()} qps={lr.qps():.1f} "
+                    f"latency={lr.latency():.0f}us "
+                    f"p99={lr.latency_percentile(0.99):.0f}us "
+                    f"max={lr.max_latency():.0f}us "
+                    f"concurrency={entry.current_concurrency} "
+                    f"errors={entry.errors_count.get_value()}")
+    return 200, CONTENT_TEXT, "\n".join(out) + "\n"
+
+
+# ----------------------------------------------------------------------- vars
+def vars_service(server, http: HttpMessage):
+    name = _sub_path(http)
+    snapshot = dump_exposed()
+    if name:
+        if name not in snapshot:
+            return 404, CONTENT_TEXT, f"no var {name!r}\n"
+        return 200, CONTENT_TEXT, f"{name} : {snapshot[name]}\n"
+    body = "".join(f"{k} : {v}\n" for k, v in snapshot.items())
+    return 200, CONTENT_TEXT, body
+
+
+# ---------------------------------------------------------------------- flags
+def flags_service(server, http: HttpMessage):
+    name = _sub_path(http)
+    if name:
+        f = _flags.find(name)
+        if f is None:
+            return 404, CONTENT_TEXT, f"no flag {name!r}\n"
+        if "setvalue" in http.query:
+            try:
+                _flags.set_flag(name, http.query["setvalue"])
+            except _flags.FlagError as e:
+                return 403, CONTENT_TEXT, f"{e}\n"
+            return 200, CONTENT_TEXT, f"{name} set to {f.value!r}\n"
+        reload_tag = " [reloadable]" if f.reloadable else ""
+        return 200, CONTENT_TEXT, (
+            f"{f.name}={f.value!r} (default {f.default!r}){reload_tag}\n"
+            f"  {f.help}\n")
+    lines = []
+    for f in _flags.list_flags():
+        tag = " [R]" if f.reloadable else ""
+        lines.append(f"{f.name}={f.value!r}{tag}  # {f.help}")
+    return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------- connections
+def connections_service(server, http: HttpMessage):
+    lines = ["fd  remote                in_bytes  out_bytes  in_msg  out_msg"]
+    if server is not None:
+        with server._conn_lock:
+            conns = list(server._connections)
+        for c in sorted(conns, key=lambda s: s.fd):
+            lines.append(
+                f"{c.fd:<3} {str(c.remote):<21} {c.in_bytes:<9} "
+                f"{c.out_bytes:<10} {c.in_messages:<7} {c.out_messages}")
+    return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
+
+
+# -------------------------------------------------------------------- sockets
+def sockets_service(server, http: HttpMessage):
+    from brpc_tpu.rpc.socket import Socket
+
+    lines = ["socket_id           fd  remote                state"]
+    for s in Socket.live_sockets():
+        state = "failed" if s.failed else "ok"
+        lines.append(f"{s.socket_id:<19} {s.fd:<3} {str(s.remote):<21} {state}")
+    return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------------------------- health
+def health_service(server, http: HttpMessage):
+    if server is not None and not server.is_running:
+        return 503, CONTENT_TEXT, "server is stopping\n"
+    return 200, CONTENT_TEXT, "OK\n"
+
+
+def version_service(server, http: HttpMessage):
+    return 200, CONTENT_TEXT, f"brpc_tpu {brpc_tpu.__version__}\n"
+
+
+# ------------------------------------------------------------------ protobufs
+def protobufs_service(server, http: HttpMessage):
+    want = _sub_path(http)
+    out = []
+    if server is not None:
+        for sname, svc in sorted(server.services.items()):
+            for mname, entry in sorted(svc._methods.items()):
+                req = entry.request_class
+                resp = entry.response_class
+                line = (f"{sname}.{mname}("
+                        f"{getattr(req, 'DESCRIPTOR', None) and req.DESCRIPTOR.full_name}"
+                        f") returns ("
+                        f"{getattr(resp, 'DESCRIPTOR', None) and resp.DESCRIPTOR.full_name})")
+                if want and want not in line:
+                    continue
+                out.append(line)
+    return 200, CONTENT_TEXT, "\n".join(out) + "\n"
+
+
+# -------------------------------------------------------------------- metrics
+def prometheus_service(server, http: HttpMessage):
+    return 200, CONTENT_TEXT, prometheus_text()
+
+
+# --------------------------------------------------------------------- fibers
+def fibers_service(server, http: HttpMessage):
+    from brpc_tpu.fiber.runtime import global_control
+
+    tc = global_control()
+    with tc._lock:
+        workers = [w for group in tc._workers.values() for w in group]
+    lines = [f"workers: {len(workers)}",
+             f"tasks_executed: {tc.tasks_executed.get_value()}"]
+    for w in workers:
+        lines.append(f"  worker[{w.index}] tag={w.tag} "
+                     f"queue={len(w.local)} alive={w.is_alive()}")
+    return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
+
+
+# -------------------------------------------------------------------- threads
+def threads_service(server, http: HttpMessage):
+    frames = sys._current_frames()
+    by_id = {t.ident: t for t in threading.enumerate()}
+    out = []
+    for tid, frame in frames.items():
+        t = by_id.get(tid)
+        name = t.name if t else f"tid{tid}"
+        out.append(f"-- {name} (tid={tid}) --")
+        out.extend(line.rstrip()
+                   for line in traceback.format_stack(frame))
+        out.append("")
+    return 200, CONTENT_TEXT, "\n".join(out) + "\n"
+
+
+# --------------------------------------------------------------------- memory
+def memory_service(server, http: HttpMessage):
+    import gc
+    import resource
+
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    counts = gc.get_count()
+    body = (f"max_rss_kb: {ru.ru_maxrss}\n"
+            f"user_time_s: {ru.ru_utime:.2f}\n"
+            f"sys_time_s: {ru.ru_stime:.2f}\n"
+            f"gc_counts: {counts}\n"
+            f"gc_objects: {len(gc.get_objects())}\n")
+    return 200, CONTENT_TEXT, body
+
+
+# ----------------------------------------------------------------------- ids
+def ids_service(server, http: HttpMessage):
+    from brpc_tpu.fiber import call_id as _cid
+
+    pool = _cid._pool if hasattr(_cid, "_pool") else None
+    n = len(pool) if pool is not None else -1
+    return 200, CONTENT_TEXT, f"live_call_ids: {n}\n"
+
+
+# ----------------------------------------------------------------------- rpcz
+def rpcz_service(server, http: HttpMessage):
+    from brpc_tpu.trace import span as _span
+
+    sub = _sub_path(http)
+    if sub:
+        try:
+            trace_id = int(sub, 16)
+        except ValueError:
+            return 404, CONTENT_TEXT, "bad trace id\n"
+        spans = _span.spans_of_trace(trace_id)
+        if not spans:
+            return 404, CONTENT_TEXT, f"no spans for trace {sub}\n"
+        return 200, CONTENT_TEXT, "".join(s.render() for s in spans)
+    recent = _span.recent_spans(int(http.query.get("count", "50")))
+    lines = ["time                 trace_id         span      kind  "
+             "latency_us  method"]
+    for s in recent:
+        lines.append(s.render_row())
+    return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
+
+
+# -------------------------------------------------------------------- logoff
+def logoff_service(server, http: HttpMessage):
+    if server is None:
+        return 400, CONTENT_TEXT, "no server\n"
+    server.stop()
+    return 200, CONTENT_TEXT, "server is logging off\n"
+
+
+register_builtin("index", index_service, "this page")
+register_builtin("status", status_service, "server + per-method stats")
+register_builtin("vars", vars_service, "all exposed metrics (/vars/<name>)")
+register_builtin("flags", flags_service,
+                 "runtime flags (/flags/<name>?setvalue=v)")
+register_builtin("connections", connections_service, "accepted connections")
+register_builtin("sockets", sockets_service, "every live socket")
+register_builtin("health", health_service, "liveness probe")
+register_builtin("version", version_service, "framework version")
+register_builtin("protobufs", protobufs_service, "registered rpc methods")
+register_builtin("brpc_metrics", prometheus_service, "prometheus exposition")
+register_builtin("fibers", fibers_service, "fiber runtime workers")
+register_builtin("threads", threads_service, "python thread stacks")
+register_builtin("memory", memory_service, "process memory stats")
+register_builtin("ids", ids_service, "live call ids")
+register_builtin("rpcz", rpcz_service, "recent rpc spans (/rpcz/<trace_id>)")

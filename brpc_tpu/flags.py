@@ -1,0 +1,170 @@
+"""flags — runtime-reloadable configuration flags (gflags equivalent).
+
+Rebuild of the reference's flag system: ~180 ``DEFINE_*`` gflags across
+src/brpc, with **reloadable** flags registered through a validator
+(``reloadable_flags.h:43-60``) that can be PUT at runtime via the
+``/flags/<name>?setvalue=`` builtin service (``builtin/flags_service.cpp``),
+and every flag surfaced as a metrics variable (``bvar/gflag.cpp``).
+
+Design notes (not a port): a Flag is a typed cell with an optional
+validator; ``set_from_string`` parses + validates + swaps atomically under
+the registry lock. Modules read flags with ``flags.get(name)`` or by holding
+the Flag object — reads are a single attribute load, no lock (Python object
+assignment is atomic), matching the reference's relaxed-read semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+_BOOL_TRUE = {"true", "1", "yes", "on", "t", "y"}
+_BOOL_FALSE = {"false", "0", "no", "off", "f", "n"}
+
+
+class FlagError(Exception):
+    pass
+
+
+class Flag:
+    """One typed, named configuration cell."""
+
+    __slots__ = ("name", "value", "default", "type", "help",
+                 "validator", "reloadable")
+
+    def __init__(self, name: str, default: Any, help: str = "",
+                 validator: Optional[Callable[[Any], bool]] = None,
+                 reloadable: bool = False, type_: Optional[type] = None):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.type = type_ or type(default)
+        self.help = help
+        self.validator = validator
+        self.reloadable = reloadable or validator is not None
+
+    # ------------------------------------------------------------------ parse
+    def parse(self, text: str) -> Any:
+        if self.type is bool:
+            low = text.strip().lower()
+            if low in _BOOL_TRUE:
+                return True
+            if low in _BOOL_FALSE:
+                return False
+            raise FlagError(f"{self.name}: not a bool: {text!r}")
+        try:
+            if self.type is int:
+                return int(text, 0)
+            if self.type is float:
+                return float(text)
+            if self.type is str:
+                return text
+        except ValueError as e:
+            raise FlagError(f"{self.name}: {e}") from None
+        raise FlagError(f"{self.name}: unsupported flag type {self.type}")
+
+    def set(self, value: Any) -> None:
+        """Validate + swap. Raises FlagError if rejected."""
+        if self.type is not type(value):
+            # allow int->float promotion only
+            if self.type is float and isinstance(value, int):
+                value = float(value)
+            else:
+                raise FlagError(
+                    f"{self.name}: expected {self.type.__name__}, "
+                    f"got {type(value).__name__}")
+        if self.validator is not None and not self.validator(value):
+            raise FlagError(f"{self.name}: value {value!r} rejected by validator")
+        self.value = value
+
+    def set_from_string(self, text: str) -> None:
+        self.set(self.parse(text))
+
+
+_registry: Dict[str, Flag] = {}
+_lock = threading.Lock()
+
+
+def define(name: str, default: Any, help: str = "",
+           validator: Optional[Callable[[Any], bool]] = None,
+           reloadable: bool = False) -> Flag:
+    """DEFINE_* equivalent. A validator makes the flag reloadable (the
+    reference's RegisterFlagValidatorOrDie contract)."""
+    with _lock:
+        if name in _registry:
+            raise FlagError(f"flag {name!r} already defined")
+        f = Flag(name, default, help, validator, reloadable)
+        _registry[name] = f
+        return f
+
+
+def get(name: str) -> Any:
+    f = _registry.get(name)
+    if f is None:
+        raise FlagError(f"unknown flag {name!r}")
+    return f.value
+
+
+def set_flag(name: str, text_or_value) -> None:
+    """Runtime update — the /flags/<name>?setvalue= path. Only reloadable
+    flags may change after startup."""
+    with _lock:
+        f = _registry.get(name)
+        if f is None:
+            raise FlagError(f"unknown flag {name!r}")
+        if not f.reloadable:
+            raise FlagError(f"flag {name!r} is not reloadable")
+        if isinstance(text_or_value, str) and f.type is not str:
+            f.set_from_string(text_or_value)
+        else:
+            f.set(text_or_value)
+
+
+def find(name: str) -> Optional[Flag]:
+    return _registry.get(name)
+
+
+def list_flags() -> List[Flag]:
+    with _lock:
+        return sorted(_registry.values(), key=lambda f: f.name)
+
+
+def reset_for_test() -> None:
+    with _lock:
+        _registry.clear()
+
+
+# ---------------------------------------------------------------- core flags
+# (defined here so every subsystem shares one registry; subsystems may also
+# define their own at import)
+def _positive(v) -> bool:
+    return v > 0
+
+
+def _non_negative(v) -> bool:
+    return v >= 0
+
+
+health_check_interval_s = define(
+    "health_check_interval_s", 3.0,
+    "seconds between re-probes of a failed server", validator=_positive)
+circuit_breaker_enabled = define(
+    "circuit_breaker_enabled", True,
+    "isolate error-rate outlier nodes", reloadable=True)
+max_body_size = define(
+    "max_body_size", 1 << 31,
+    "largest accepted wire message", validator=_positive)
+idle_timeout_s = define(
+    "idle_timeout_s", -1.0,
+    "close connections idle longer than this (<=0 disables)",
+    reloadable=True)
+log_error_text = define(
+    "log_error_text", False,
+    "log every failed RPC's error text", reloadable=True)
+rpcz_sample_ratio = define(
+    "rpcz_sample_ratio", 1.0,
+    "fraction of RPCs recorded by rpcz", validator=lambda v: 0 <= v <= 1)
+rpc_dump_ratio = define(
+    "rpc_dump_ratio", 0.0,
+    "fraction of requests sampled to dump files",
+    validator=lambda v: 0 <= v <= 1)

@@ -1,0 +1,49 @@
+"""json2pb — JSON <-> protobuf bridging for the HTTP protocol family.
+
+Counterpart of the reference's ``src/json2pb`` (``pb_to_json.cpp`` /
+``json_to_pb.cpp``): the HTTP protocol serves protobuf services to JSON
+clients by converting request bodies to messages and responses back. We
+build on ``google.protobuf.json_format`` rather than a hand-rolled walker —
+the conversion rules (int64 as string, bytes as base64, enums by name) match
+proto3 JSON mapping, which is what the reference's grpc/http gateway peers
+expect.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Type
+
+from google.protobuf import json_format
+
+
+class Json2PbError(ValueError):
+    pass
+
+
+def json_to_pb(data, message_class: Type, ignore_unknown_fields: bool = True):
+    """Parse a JSON document (str/bytes) into a new message instance."""
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        data = bytes(data).decode("utf-8", errors="strict")
+    msg = message_class()
+    if data.strip() == "":
+        return msg  # empty body = default message (GET-style calls)
+    try:
+        json_format.Parse(data, msg,
+                          ignore_unknown_fields=ignore_unknown_fields)
+    except (json_format.ParseError, UnicodeDecodeError) as e:
+        raise Json2PbError(str(e)) from None
+    return msg
+
+
+def pb_to_json(message, pretty: bool = False,
+               always_print_fields_with_no_presence: bool = False) -> str:
+    try:
+        return json_format.MessageToJson(
+            message,
+            indent=2 if pretty else None,
+            preserving_proto_field_name=True,
+            always_print_fields_with_no_presence=(
+                always_print_fields_with_no_presence),
+        )
+    except Exception as e:
+        raise Json2PbError(str(e)) from None

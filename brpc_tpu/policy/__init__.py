@@ -21,7 +21,9 @@ def ensure_registered() -> None:
         from brpc_tpu.rpc.protocol import register_protocol
         from brpc_tpu.policy.trpc_std import TrpcStdProtocol
         from brpc_tpu.policy.trpc_stream import TrpcStreamProtocol
+        from brpc_tpu.policy.http_protocol import HttpProtocol
 
         register_protocol(TrpcStdProtocol())
         register_protocol(TrpcStreamProtocol())
+        register_protocol(HttpProtocol())  # probed last: magic-less
         _done = True

@@ -65,7 +65,12 @@ class _NodeState:
 
     @property
     def is_down(self) -> bool:
-        return time.monotonic() < self.down_until or self.breaker.isolated
+        if time.monotonic() < self.down_until:
+            return True
+        from brpc_tpu import flags as _flags
+
+        return (self.breaker.isolated
+                and _flags.get("circuit_breaker_enabled"))
 
 
 class LoadBalancer:

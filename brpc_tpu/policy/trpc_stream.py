@@ -13,6 +13,7 @@ import struct
 from typing import Optional, Tuple
 
 from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.policy.trpc_std import MAX_BODY_SIZE
 from brpc_tpu.proto import rpc_meta_pb2
 from brpc_tpu.rpc.protocol import (
     PARSE_BAD,
@@ -53,6 +54,8 @@ class TrpcStreamProtocol(Protocol):
             HEADER_FMT, buf.fetch(HEADER_SIZE))
         if magic != MAGIC:
             return PARSE_TRY_OTHERS, None
+        if meta_size + body_size > MAX_BODY_SIZE:
+            return PARSE_BAD, None  # corrupt size field: fail the socket
         total = HEADER_SIZE + meta_size + body_size
         if len(buf) < total:
             return PARSE_NOT_ENOUGH_DATA, None

@@ -120,18 +120,27 @@ class ParallelChannel:
 
         def make_done(merger, sub):
             def sub_done(sub_cntl):
+                merge_rc = 0
+                if not sub_cntl.failed():
+                    with merge_lock:
+                        try:
+                            merge_rc = merger.merge(response,
+                                                    sub_cntl.response) or 0
+                        except Exception:
+                            merge_rc = -1
                 with state["lock"]:
-                    if sub_cntl.failed():
+                    if sub_cntl.failed() or merge_rc != 0:
+                        # a merger failure fails the sub-call (reference
+                        # counts it against fail_limit)
                         state["failed"] += 1
                         if state["first_error"] is None:
-                            state["first_error"] = (sub_cntl.error_code,
-                                                    sub_cntl.error_text())
-                    else:
-                        with merge_lock:
-                            try:
-                                merger.merge(response, sub_cntl.response)
-                            except Exception:
-                                pass
+                            if sub_cntl.failed():
+                                state["first_error"] = (sub_cntl.error_code,
+                                                        sub_cntl.error_text())
+                            else:
+                                state["first_error"] = (
+                                    errors.ERESPONSE,
+                                    f"response merger failed ({merge_rc})")
                     state["pending"] -= 1
                     last = state["pending"] == 0
                 if last:
@@ -161,76 +170,87 @@ class SelectiveChannel:
 
     def __init__(self, max_retry: int = 3):
         self._subs: List[Channel] = []
-        self._fail_streak: List[int] = []
-        self._down_until: List[float] = []
+        self._states: List[object] = []  # shared _NodeState machinery
         self._rr = 0
         self._lock = threading.Lock()
         self.max_retry = max_retry
 
     def add_channel(self, channel: Channel) -> int:
+        from brpc_tpu.policy.load_balancers import _NodeState
+
         with self._lock:
             self._subs.append(channel)
-            self._fail_streak.append(0)
-            self._down_until.append(0.0)
+            self._states.append(_NodeState())
             return len(self._subs) - 1
 
     def _pick(self) -> Optional[int]:
-        import time
-
-        now = time.monotonic()
         with self._lock:
             n = len(self._subs)
             for off in range(n):
                 idx = (self._rr + off) % n
-                if self._down_until[idx] <= now:
+                if not self._states[idx].is_down:
                     self._rr = idx + 1
                     return idx
             if n:  # all parked: least-recently-parked anyway
-                return min(range(n), key=lambda i: self._down_until[i])
+                return min(range(n),
+                           key=lambda i: self._states[i].down_until)
         return None
-
-    def _feedback(self, idx: int, ok: bool) -> None:
-        import time
-
-        with self._lock:
-            if ok:
-                self._fail_streak[idx] = 0
-            else:
-                self._fail_streak[idx] += 1
-                if self._fail_streak[idx] >= 2:
-                    self._down_until[idx] = time.monotonic() + 2.0
 
     def call_method(self, method: MethodDescriptor, request, response=None,
                     controller: Optional[Controller] = None, done=None):
+        """Sync when done is None; async otherwise (the retry loop runs on
+        a fiber worker and done fires on completion — same contract as
+        Channel.call_method)."""
         cntl = controller or Controller()
         if response is None and method.response_class is not None:
             response = method.response_class()
-        last_err = None
-        for _ in range(1 + self.max_retry):
-            idx = self._pick()
-            if idx is None:
-                cntl.set_failed(errors.EHOSTDOWN, "no sub-channels")
-                break
-            sub_cntl = Controller()
-            sub_cntl.timeout_ms = cntl.timeout_ms
-            try:
-                out = self._subs[idx].call_method(
-                    method, request, response=response,
-                    controller=sub_cntl)
-                self._feedback(idx, True)
+
+        def run_attempts():
+            import time as _time
+
+            last_err = None
+            for _ in range(1 + self.max_retry):
+                idx = self._pick()
+                if idx is None:
+                    cntl.set_failed(errors.EHOSTDOWN, "no sub-channels")
+                    break
+                sub_cntl = Controller()
+                sub_cntl.timeout_ms = cntl.timeout_ms
+                start = _time.perf_counter_ns() // 1000
+                try:
+                    out = self._subs[idx].call_method(
+                        method, request, response=response,
+                        controller=sub_cntl)
+                except RpcError as e:
+                    self._states[idx].on_feedback(
+                        e.error_code,
+                        _time.perf_counter_ns() // 1000 - start)
+                    last_err = e
+                    continue
+                self._states[idx].on_feedback(
+                    errors.OK, _time.perf_counter_ns() // 1000 - start)
                 cntl._response = out
-                if done is not None:
-                    done(cntl)
-                return cntl if done is not None else out
-            except RpcError as e:
-                self._feedback(idx, False)
-                last_err = e
-        if last_err is not None and not cntl.failed():
-            cntl.set_failed(last_err.error_code, str(last_err))
+                return out
+            if last_err is not None and not cntl.failed():
+                cntl.set_failed(last_err.error_code, str(last_err))
+            return None
+
         if done is not None:
-            done(cntl)
+            from brpc_tpu.fiber import runtime
+
+            def run_async():
+                run_attempts()
+                try:
+                    done(cntl)
+                except Exception:
+                    pass
+
+            runtime.start_background(run_async)
             return cntl
-        raise RpcError(cntl)
+        out = run_attempts()
+        if cntl.failed():
+            raise RpcError(cntl)
+        return out
 
 
 class PartitionParser:

@@ -57,6 +57,7 @@ class Controller:
         self._srv_meta = None
         self._srv_socket = None
         self._response_sent = False
+        self.http_request = None  # HttpMessage when the call arrived via http
         # streaming
         self.stream_id = 0            # client: stream created before call
         self._accepted_stream_id = 0  # server: stream accepted in handler
@@ -93,6 +94,11 @@ class Controller:
         self._response = response
         self._done = done
         self._start_us = time.perf_counter_ns() // 1000
+        if self.span is None:
+            from brpc_tpu.trace import span as _span
+
+            self.span = _span.start_client_span(
+                method.service_name, method.method_name)
         self._call_id = _cid.id_create(data=self, on_error=_handle_id_error)
         opts = channel.options
         if self.timeout_ms is None:
@@ -220,6 +226,13 @@ class Controller:
         if self._current_socket is not None:
             self._current_socket.remove_pending_id(cid)
         self.latency_us = time.perf_counter_ns() // 1000 - self._start_us
+        if self.span is not None:
+            if self._retry_count:
+                self.span.annotate(f"retries={self._retry_count}")
+            if self._backup_sent:
+                self.span.annotate("backup request sent")
+            self.span.response_size = len(self.response_attachment)
+            self.span.end(self._error_code)
         if self._channel is not None:
             self._channel._on_rpc_end(self)
         done = self._done
@@ -263,17 +276,20 @@ def _fire_id_error(call_id: int, code: int) -> None:
 
 
 def handle_response_message(msg) -> None:
-    """Client-side entry from InputMessenger (reference ProcessRpcResponse)."""
-    from brpc_tpu.policy.trpc_std import TrpcStdProtocol
+    """Client-side entry from InputMessenger (reference ProcessRpcResponse).
 
+    Protocol-generic: any protocol that can produce an RpcMeta-shaped
+    ``msg.meta`` (trpc_std natively; http by header synthesis) funnels
+    through the same attempt-version verification and completion path.
+    """
     meta = msg.meta
     cid = meta.correlation_id
     try:
         cntl = _cid.id_lock_verify(cid, meta.attempt_version)
     except _cid.IdGone:
         return  # stale attempt or finished RPC: drop silently
-    payload, attachment = TrpcStdProtocol.split_attachment(msg)
-    if not TrpcStdProtocol.verify_checksum(meta, payload):
+    payload, attachment = msg.protocol.split_attachment(msg)
+    if not msg.protocol.verify_checksum(meta, payload):
         cntl.set_failed(errors.ERESPONSE, "response checksum mismatch")
         cntl._finish_locked()
         return

@@ -44,11 +44,14 @@ class HealthChecker:
     interval instead of all at once (the reference's
     cluster_recover_policy.cpp de-thundering)."""
 
-    def __init__(self, lb, interval_s: float = 1.0,
+    def __init__(self, lb, interval_s: Optional[float] = None,
                  probe: Optional[Callable[[EndPoint], bool]] = None,
                  recover_guard=None):
+        from brpc_tpu import flags as _flags
         from brpc_tpu.rpc.circuit_breaker import ClusterRecoverGuard
 
+        if interval_s is None:  # default rides the reloadable flag
+            interval_s = _flags.get("health_check_interval_s")
         self._lb = lb
         self._interval = interval_s
         self._probe = probe or tcp_probe

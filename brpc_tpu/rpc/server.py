@@ -118,6 +118,7 @@ class ServerOptions:
     max_concurrency: int = 0          # whole-server admission
     auth: object = None               # Authenticator (policy/auth.py)
     idle_timeout_s: int = -1
+    rpc_dump_dir: Optional[str] = None  # sample requests here (rpc_dump)
 
 
 class Server:
@@ -135,6 +136,11 @@ class Server:
         self.concurrency = 0
         self._concurrency_lock = threading.Lock()
         self.requests_processed = Adder()
+        self.rpc_dumper = None
+        if self.options.rpc_dump_dir:
+            from brpc_tpu.trace.rpc_dump import RpcDumper
+
+            self.rpc_dumper = RpcDumper(self.options.rpc_dump_dir)
 
     # -------------------------------------------------------------- services
     def add_service(self, service: Service) -> "Server":

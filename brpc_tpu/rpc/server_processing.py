@@ -27,8 +27,15 @@ def process_rpc_request(protocol, msg, server) -> None:
         return  # request arrived on a client-only connection: drop
     server.requests_processed.put(1)
     cntl = Controller.server_controller(server, sock, meta)
+    from brpc_tpu.trace import span as _span
+
+    cntl.span = _span.start_server_span(
+        meta, meta.request.service_name, meta.request.method_name,
+        peer=str(sock.remote))
 
     def send_error(code: int, text: str = "") -> None:
+        if cntl.span is not None:  # rejected requests must reach /rpcz too
+            cntl.span.end(code)
         _send_response(protocol, sock, meta, code,
                        text or errors.error_text(code),
                        b"", b"", _compress.COMPRESS_NONE)
@@ -74,6 +81,8 @@ def process_rpc_request(protocol, msg, server) -> None:
         settled[0] = True
         entry.on_response(time.perf_counter_ns() // 1000 - start_us, error_code)
         server.sub_concurrency()
+        if cntl.span is not None:
+            cntl.span.end(error_code)
 
     responded = [False]
 
@@ -103,6 +112,11 @@ def process_rpc_request(protocol, msg, server) -> None:
 
     try:
         payload, attachment = protocol.split_attachment(msg)
+        if cntl.span is not None:
+            cntl.span.request_size = len(payload) + len(attachment)
+        dumper = getattr(server, "rpc_dumper", None)
+        if dumper is not None and dumper.ask_to_be_sampled():
+            dumper.sample(meta, payload + attachment)
         if not protocol.verify_checksum(meta, payload):
             cntl.set_failed(errors.EREQUEST, "request checksum mismatch")
             return done()

@@ -105,7 +105,12 @@ class Stream:
             return errors.ERPCTIMEDOUT
         n = len(data)
         with self._write_lock:
-            while (self._produced + n >
+            # block only while bytes are in flight: a message larger than
+            # the whole window must still be sendable once the window is
+            # empty, else it could never succeed (reference AppendIfNotFull
+            # checks in-flight bytes, not message size)
+            while (self._produced > self._remote_consumed
+                   and self._produced + n >
                    self._remote_consumed + self.options.window_bytes):
                 if self.closed:
                     return errors.ESTREAMCLOSED

@@ -1,0 +1,29 @@
+"""trace — per-RPC span tracing (rpcz) and request sampling (rpc_dump).
+
+Counterpart of the reference's ``src/brpc/span.*`` + ``rpc_dump.*``
+(SURVEY §5.1): client and server spans with annotations, sampled into an
+in-memory SpanDB browsable at ``/rpcz``; trace ids propagate through
+RpcMeta so multi-hop calls stitch into one trace. rpc_dump samples inbound
+requests to files that ``tools/rpc_replay`` re-issues.
+"""
+
+from brpc_tpu.trace.span import (
+    Span,
+    start_client_span,
+    start_server_span,
+    recent_spans,
+    spans_of_trace,
+    reset_for_test,
+)
+from brpc_tpu.trace.rpc_dump import RpcDumper, RpcDumpLoader
+
+__all__ = [
+    "Span",
+    "start_client_span",
+    "start_server_span",
+    "recent_spans",
+    "spans_of_trace",
+    "reset_for_test",
+    "RpcDumper",
+    "RpcDumpLoader",
+]

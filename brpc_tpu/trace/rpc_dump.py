@@ -1,0 +1,109 @@
+"""rpc_dump — rate-limited request sampling to replayable files.
+
+Rebuild of the reference's ``rpc_dump.h:30-57`` (AskToBeSampled hooked into
+ProcessRpcRequest) + the dump format consumed by ``tools/rpc_replay``. A
+sampled request is serialized as one length-prefixed record::
+
+    u32 meta_size | u32 body_size | RpcMeta pb | body bytes
+
+so a dump file is just a trpc_std byte stream minus the magic — replay can
+re-pack each record through any protocol.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import struct
+import threading
+import time
+from typing import Iterator, Optional, Tuple
+
+from brpc_tpu import flags as _flags
+from brpc_tpu.proto import rpc_meta_pb2
+
+_REC_FMT = "!II"
+_REC_SIZE = struct.calcsize(_REC_FMT)
+
+MAX_FILE_BYTES = 64 << 20
+
+
+class RpcDumper:
+    """Per-server sampler writing to <dir>/requests.<n>.dump files."""
+
+    def __init__(self, directory: str, max_file_bytes: int = MAX_FILE_BYTES):
+        self.directory = directory
+        self.max_file_bytes = max_file_bytes
+        self._lock = threading.Lock()
+        self._file = None
+        self._file_bytes = 0
+        self._file_index = 0
+        self.sampled_count = 0
+        os.makedirs(directory, exist_ok=True)
+
+    def ask_to_be_sampled(self) -> bool:
+        ratio = _flags.get("rpc_dump_ratio")
+        if ratio <= 0.0:
+            return False
+        return ratio >= 1.0 or random.random() < ratio
+
+    def sample(self, meta: rpc_meta_pb2.RpcMeta, body: bytes) -> None:
+        record = pack_record(meta, body)
+        with self._lock:
+            if self._file is None or self._file_bytes > self.max_file_bytes:
+                self._roll()
+            self._file.write(record)
+            self._file.flush()
+            self._file_bytes += len(record)
+            self.sampled_count += 1
+
+    def _roll(self) -> None:
+        if self._file is not None:
+            self._file.close()
+        path = os.path.join(self.directory,
+                            f"requests.{self._file_index}.dump")
+        self._file_index += 1
+        self._file = open(path, "wb")
+        self._file_bytes = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+def pack_record(meta: rpc_meta_pb2.RpcMeta, body: bytes) -> bytes:
+    meta_bytes = meta.SerializeToString()
+    return (struct.pack(_REC_FMT, len(meta_bytes), len(body))
+            + meta_bytes + body)
+
+
+class RpcDumpLoader:
+    """Iterate records of one dump file (or a directory of them)."""
+
+    def __init__(self, path: str):
+        self.paths = []
+        if os.path.isdir(path):
+            self.paths = sorted(
+                os.path.join(path, f) for f in os.listdir(path)
+                if f.endswith(".dump"))
+        else:
+            self.paths = [path]
+
+    def __iter__(self) -> Iterator[Tuple[rpc_meta_pb2.RpcMeta, bytes]]:
+        for p in self.paths:
+            with open(p, "rb") as f:
+                data = f.read()
+            pos = 0
+            while pos + _REC_SIZE <= len(data):
+                meta_size, body_size = struct.unpack_from(_REC_FMT, data, pos)
+                pos += _REC_SIZE
+                if pos + meta_size + body_size > len(data):
+                    break  # truncated tail record
+                meta = rpc_meta_pb2.RpcMeta.FromString(
+                    data[pos:pos + meta_size])
+                pos += meta_size
+                body = data[pos:pos + body_size]
+                pos += body_size
+                yield meta, body

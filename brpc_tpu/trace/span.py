@@ -1,0 +1,165 @@
+"""Span — one timed segment of an RPC, the unit of /rpcz.
+
+Rebuild of ``src/brpc/span.h:47-88`` / ``span.cpp``: a client span is born
+in Channel.call_method, a server span in request processing; both carry
+trace_id/span_id/parent_span_id (propagated via RpcMeta, SURVEY §5.1) and a
+list of timestamped annotations. Finished spans land in a bounded in-memory
+SpanDB (the reference persists to disk via the bvar Collector; our DB is a
+ring — the /rpcz surface is identical, the storage budget explicit).
+
+Sampling: ``rpcz_sample_ratio`` flag (1.0 = record everything). The
+decision is made once per trace at the root and inherited downstream, so a
+trace is either fully recorded or not at all.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from brpc_tpu import flags as _flags
+
+SPAN_DB_CAPACITY = 10000
+
+KIND_CLIENT = "client"
+KIND_SERVER = "server"
+
+
+class Span:
+    __slots__ = ("trace_id", "span_id", "parent_span_id", "kind",
+                 "service", "method", "peer", "start_us", "end_us",
+                 "error_code", "request_size", "response_size",
+                 "annotations", "_ended")
+
+    def __init__(self, trace_id: int, span_id: int, parent_span_id: int,
+                 kind: str, service: str = "", method: str = "",
+                 peer: str = ""):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_span_id = parent_span_id
+        self.kind = kind
+        self.service = service
+        self.method = method
+        self.peer = peer
+        self.start_us = time.time() * 1e6
+        self.end_us = 0.0
+        self.error_code = 0
+        self.request_size = 0
+        self.response_size = 0
+        self.annotations: List = []  # (us, text)
+        self._ended = False
+
+    # ------------------------------------------------------------ lifecycle
+    def annotate(self, text: str) -> None:
+        """TRACEPRINTF equivalent."""
+        self.annotations.append((time.time() * 1e6, text))
+
+    def end(self, error_code: int = 0) -> None:
+        if self._ended:
+            return
+        self._ended = True
+        self.end_us = time.time() * 1e6
+        self.error_code = error_code
+        _db_add(self)
+
+    @property
+    def latency_us(self) -> float:
+        return (self.end_us or time.time() * 1e6) - self.start_us
+
+    # ------------------------------------------------------------ rendering
+    def render_row(self) -> str:
+        ts = time.strftime("%Y-%m-%d %H:%M:%S",
+                           time.localtime(self.start_us / 1e6))
+        return (f"{ts}  {self.trace_id:016x} {self.span_id:08x}  "
+                f"{self.kind:<6}{self.latency_us:>10.0f}  "
+                f"{self.service}.{self.method}")
+
+    def render(self) -> str:
+        out = [self.render_row()]
+        if self.peer:
+            out.append(f"    peer={self.peer}")
+        if self.error_code:
+            out.append(f"    error_code={self.error_code}")
+        out.append(f"    request_size={self.request_size} "
+                   f"response_size={self.response_size}")
+        for us, text in self.annotations:
+            out.append(f"    +{us - self.start_us:.0f}us  {text}")
+        return "\n".join(out) + "\n"
+
+
+# -------------------------------------------------------------------- SpanDB
+_db: deque = deque(maxlen=SPAN_DB_CAPACITY)
+_by_trace: Dict[int, List[Span]] = {}
+_db_lock = threading.Lock()
+
+
+def _db_add(span: Span) -> None:
+    with _db_lock:
+        if len(_db) == _db.maxlen:
+            old = _db[0]
+            spans = _by_trace.get(old.trace_id)
+            if spans is not None:
+                try:
+                    spans.remove(old)
+                except ValueError:
+                    pass
+                if not spans:
+                    del _by_trace[old.trace_id]
+        _db.append(span)
+        _by_trace.setdefault(span.trace_id, []).append(span)
+
+
+def recent_spans(count: int = 50) -> List[Span]:
+    with _db_lock:
+        return list(_db)[-count:][::-1]
+
+
+def spans_of_trace(trace_id: int) -> List[Span]:
+    with _db_lock:
+        return list(_by_trace.get(trace_id, ()))
+
+
+def reset_for_test() -> None:
+    with _db_lock:
+        _db.clear()
+        _by_trace.clear()
+
+
+# ------------------------------------------------------------------ creation
+def _gen_id() -> int:
+    return random.getrandbits(63) | 1
+
+
+def _sampled() -> bool:
+    ratio = _flags.get("rpcz_sample_ratio")
+    return ratio >= 1.0 or random.random() < ratio
+
+
+def start_client_span(service: str, method: str,
+                      parent: Optional[Span] = None) -> Optional[Span]:
+    """Root or child client span. Returns None when the trace isn't
+    sampled (callers must tolerate span=None everywhere)."""
+    if parent is not None:
+        return Span(parent.trace_id, _gen_id(), parent.span_id,
+                    KIND_CLIENT, service, method)
+    if not _sampled():
+        return None
+    tid = _gen_id()
+    return Span(tid, tid, 0, KIND_CLIENT, service, method)
+
+
+def start_server_span(meta, service: str, method: str,
+                      peer: str = "") -> Optional[Span]:
+    """Server span continuing a propagated trace (or rooting a new one
+    when the client didn't trace)."""
+    trace_id = meta.request.trace_id if meta is not None else 0
+    if trace_id:
+        return Span(trace_id, _gen_id(), meta.request.span_id,
+                    KIND_SERVER, service, method, peer)
+    if not _sampled():
+        return None
+    tid = _gen_id()
+    return Span(tid, tid, 0, KIND_SERVER, service, method, peer)

@@ -1,0 +1,345 @@
+"""HTTP protocol family: parser conformance (the reference's per-protocol
+wire-byte unittests, test/brpc_http_rpc_protocol_unittest.cpp), json2pb,
+flags, builtin services served off the same RPC port, rpcz, rpc_dump."""
+
+import json
+import time
+
+import pytest
+
+from brpc_tpu import flags as _flags
+from brpc_tpu import json2pb
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.policy.http_protocol import (
+    CONTENT_JSON,
+    CONTENT_PROTO,
+    HttpProtocol,
+    http_fetch,
+    parse_http_message,
+    render_request,
+    render_response,
+)
+from brpc_tpu.proto import echo_pb2
+from brpc_tpu.rpc import (
+    Channel,
+    ChannelOptions,
+    Server,
+    ServerOptions,
+    Service,
+    Stub,
+    errors,
+)
+from brpc_tpu.rpc.protocol import (
+    PARSE_BAD,
+    PARSE_NOT_ENOUGH_DATA,
+    PARSE_TRY_OTHERS,
+)
+
+ECHO_DESC = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+
+class EchoServiceImpl(Service):
+    DESCRIPTOR = ECHO_DESC
+
+    def Echo(self, cntl, request, done):
+        cntl.response_attachment = cntl.request_attachment
+        return echo_pb2.EchoResponse(message=request.message,
+                                     payload=request.payload)
+
+
+@pytest.fixture()
+def http_server():
+    server = Server().add_service(EchoServiceImpl()).start("127.0.0.1:0")
+    yield server
+    server.stop()
+    server.join(timeout=2)
+
+
+def addr(server):
+    return str(server.listen_endpoint())
+
+
+# ---------------------------------------------------------------- wire parser
+class TestHttpParser:
+    def test_request_roundtrip(self):
+        raw = render_request("POST", "/Svc/M?x=1&y=b", "h", b"body",
+                             extra_headers={"X-Foo": "bar"})
+        buf = IOBuf(raw)
+        rc, msg = parse_http_message(buf)
+        assert rc == 0
+        assert msg.method == "POST" and msg.path == "/Svc/M"
+        assert msg.query == {"x": "1", "y": "b"}
+        assert msg.header("x-foo") == "bar"
+        assert msg.body == b"body"
+        assert len(buf) == 0
+
+    def test_response_roundtrip(self):
+        raw = render_response(404, "text/plain", "nope")
+        rc, msg = parse_http_message(IOBuf(raw))
+        assert rc == 0
+        assert not msg.is_request
+        assert msg.status == 404
+        assert msg.body == b"nope"
+
+    def test_incremental_feed(self):
+        raw = render_request("GET", "/vars", "h")
+        for cut in (1, 10, len(raw) - 1):
+            buf = IOBuf(raw[:cut])
+            rc, _ = parse_http_message(buf)
+            assert rc == PARSE_NOT_ENOUGH_DATA
+        rc, msg = parse_http_message(IOBuf(raw))
+        assert rc == 0 and msg.method == "GET"
+
+    def test_other_protocol_bytes(self):
+        rc, _ = parse_http_message(IOBuf(b"TRPC\x00\x00\x00\x01"))
+        assert rc == PARSE_TRY_OTHERS
+        # TRAC could still become TRACE -> not enough data yet
+        rc, _ = parse_http_message(IOBuf(b"TRAC"))
+        assert rc == PARSE_NOT_ENOUGH_DATA
+
+    def test_bad_header(self):
+        rc, _ = parse_http_message(
+            IOBuf(b"GET /x HTTP/1.1\r\nbroken line\r\n\r\n"))
+        assert rc == PARSE_BAD
+
+    def test_chunked_body(self):
+        raw = (b"POST /x HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
+               b"4\r\nWiki\r\n5\r\npedia\r\n0\r\n\r\n")
+        rc, msg = parse_http_message(IOBuf(raw))
+        assert rc == 0
+        assert msg.body == b"Wikipedia"
+
+    def test_chunked_incomplete(self):
+        raw = (b"POST /x HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
+               b"4\r\nWi")
+        rc, _ = parse_http_message(IOBuf(raw))
+        assert rc == PARSE_NOT_ENOUGH_DATA
+
+    def test_pipelined_requests(self):
+        raw = render_request("GET", "/a", "h") + render_request("GET", "/b", "h")
+        buf = IOBuf(raw)
+        rc, m1 = parse_http_message(buf)
+        rc2, m2 = parse_http_message(buf)
+        assert (rc, rc2) == (0, 0)
+        assert m1.path == "/a" and m2.path == "/b"
+
+
+# -------------------------------------------------------------------- json2pb
+class TestJson2Pb:
+    def test_roundtrip(self):
+        req = echo_pb2.EchoRequest(message="hi", payload=b"\x01\x02")
+        text = json2pb.pb_to_json(req)
+        back = json2pb.json_to_pb(text, echo_pb2.EchoRequest)
+        assert back == req
+
+    def test_bad_json(self):
+        with pytest.raises(json2pb.Json2PbError):
+            json2pb.json_to_pb("{not json", echo_pb2.EchoRequest)
+
+    def test_unknown_fields_ignored(self):
+        msg = json2pb.json_to_pb('{"message": "x", "bogus": 1}',
+                                 echo_pb2.EchoRequest)
+        assert msg.message == "x"
+
+
+# ---------------------------------------------------------------------- flags
+class TestFlags:
+    def test_define_get_set(self):
+        f = _flags.define("test_flag_xyz", 5, "help", reloadable=True)
+        assert _flags.get("test_flag_xyz") == 5
+        _flags.set_flag("test_flag_xyz", "7")
+        assert f.value == 7
+
+    def test_validator_rejects(self):
+        _flags.define("test_flag_pos", 1.0, validator=lambda v: v > 0)
+        with pytest.raises(_flags.FlagError):
+            _flags.set_flag("test_flag_pos", "-2.0")
+        assert _flags.get("test_flag_pos") == 1.0
+
+    def test_non_reloadable(self):
+        _flags.define("test_flag_frozen", "a")
+        with pytest.raises(_flags.FlagError):
+            _flags.set_flag("test_flag_frozen", "b")
+
+    def test_bool_parsing(self):
+        f = _flags.define("test_flag_bool", False, reloadable=True)
+        _flags.set_flag("test_flag_bool", "true")
+        assert f.value is True
+        _flags.set_flag("test_flag_bool", "0")
+        assert f.value is False
+
+
+# ----------------------------------------------------------- builtin services
+class TestBuiltinServices:
+    def test_index_lists_services(self, http_server):
+        resp = http_fetch(addr(http_server), "GET", "/")
+        assert resp.status == 200
+        assert b"/status" in resp.body and b"/vars" in resp.body
+
+    def test_status(self, http_server):
+        resp = http_fetch(addr(http_server), "GET", "/status")
+        assert resp.status == 200
+        assert b"EchoService" in resp.body
+
+    def test_health_version(self, http_server):
+        assert http_fetch(addr(http_server), "GET", "/health").body == b"OK\n"
+        assert b"brpc_tpu" in http_fetch(addr(http_server), "GET",
+                                         "/version").body
+
+    def test_vars(self, http_server):
+        from brpc_tpu.metrics import Status
+
+        Status(42).expose("test_http_var")
+        resp = http_fetch(addr(http_server), "GET", "/vars")
+        assert b"test_http_var : 42" in resp.body
+        resp = http_fetch(addr(http_server), "GET", "/vars/test_http_var")
+        assert resp.body == b"test_http_var : 42\n"
+
+    def test_flags_list_and_set(self, http_server):
+        resp = http_fetch(addr(http_server), "GET", "/flags")
+        assert b"circuit_breaker_enabled" in resp.body
+        resp = http_fetch(addr(http_server), "GET",
+                          "/flags/idle_timeout_s?setvalue=30")
+        assert resp.status == 200
+        assert _flags.get("idle_timeout_s") == 30.0
+        _flags.set_flag("idle_timeout_s", "-1")
+
+    def test_flags_set_rejected(self, http_server):
+        resp = http_fetch(addr(http_server), "GET",
+                          "/flags/rpcz_sample_ratio?setvalue=2.0")
+        assert resp.status == 403
+
+    def test_connections_and_sockets(self, http_server):
+        resp = http_fetch(addr(http_server), "GET", "/connections")
+        assert resp.status == 200
+        resp = http_fetch(addr(http_server), "GET", "/sockets")
+        assert resp.status == 200
+
+    def test_prometheus(self, http_server):
+        resp = http_fetch(addr(http_server), "GET", "/brpc_metrics")
+        assert resp.status == 200
+
+    def test_protobufs(self, http_server):
+        resp = http_fetch(addr(http_server), "GET", "/protobufs")
+        assert b"EchoService.Echo" in resp.body
+
+    def test_unknown_builtin_falls_through_to_404(self, http_server):
+        resp = http_fetch(addr(http_server), "GET", "/no_such_thing")
+        assert resp.status == 404
+
+
+# ------------------------------------------------------------------- JSON RPC
+class TestJsonRpc:
+    def test_json_call(self, http_server):
+        body = json.dumps({"message": "json hello"}).encode()
+        resp = http_fetch(addr(http_server), "POST", "/EchoService/Echo",
+                          body=body, content_type=CONTENT_JSON)
+        assert resp.status == 200
+        data = json.loads(resp.body)
+        assert data["message"] == "json hello"
+
+    def test_json_call_bad_body(self, http_server):
+        resp = http_fetch(addr(http_server), "POST", "/EchoService/Echo",
+                          body=b"{oops", content_type=CONTENT_JSON)
+        assert resp.status == 400
+        assert json.loads(resp.body)["error_code"] == errors.EREQUEST
+
+    def test_no_such_method(self, http_server):
+        resp = http_fetch(addr(http_server), "POST", "/EchoService/Nope",
+                          body=b"{}", content_type=CONTENT_JSON)
+        assert resp.status == 404
+
+    def test_no_such_service(self, http_server):
+        resp = http_fetch(addr(http_server), "POST", "/Nope/Echo",
+                          body=b"{}", content_type=CONTENT_JSON)
+        assert resp.status == 404
+
+
+# --------------------------------------------------------------- pb-over-http
+class TestPbOverHttp:
+    def test_channel_http_protocol(self, http_server):
+        ch = Channel(ChannelOptions(protocol="http")).init(addr(http_server))
+        stub = Stub(ch, ECHO_DESC)
+        resp = stub.Echo(echo_pb2.EchoRequest(message="over http"))
+        assert resp.message == "over http"
+
+    def test_attachment_over_http(self, http_server):
+        from brpc_tpu.rpc import Controller, MethodDescriptor
+
+        ch = Channel(ChannelOptions(protocol="http")).init(addr(http_server))
+        md = MethodDescriptor.from_pb(ECHO_DESC.methods_by_name["Echo"])
+        cntl = Controller()
+        cntl.request_attachment = b"side-channel"
+        resp = ch.call_method(md, echo_pb2.EchoRequest(message="x"),
+                              controller=cntl)
+        assert resp.message == "x"
+        assert cntl.response_attachment == b"side-channel"
+
+    def test_many_sequential_calls_one_connection(self, http_server):
+        ch = Channel(ChannelOptions(protocol="http")).init(addr(http_server))
+        stub = Stub(ch, ECHO_DESC)
+        for i in range(20):
+            assert stub.Echo(echo_pb2.EchoRequest(message=str(i))).message == str(i)
+
+
+# ----------------------------------------------------------------------- rpcz
+class TestRpcz:
+    def test_spans_recorded_and_rendered(self, http_server):
+        from brpc_tpu.trace import span as _span
+
+        _span.reset_for_test()
+        ch = Channel().init(addr(http_server))
+        stub = Stub(ch, ECHO_DESC)
+        stub.Echo(echo_pb2.EchoRequest(message="traced"))
+        # the server span is recorded just after the response is written —
+        # wait for it
+        deadline = time.time() + 2
+        while time.time() < deadline:
+            spans = _span.recent_spans(10)
+            if {s.kind for s in spans} >= {"client", "server"}:
+                break
+            time.sleep(0.01)
+        kinds = {s.kind for s in spans}
+        assert "client" in kinds and "server" in kinds
+        client = next(s for s in spans if s.kind == "client")
+        server_span = next(s for s in spans if s.kind == "server")
+        # propagation: same trace, parent chain intact
+        assert client.trace_id == server_span.trace_id
+        assert server_span.parent_span_id == client.span_id
+        resp = http_fetch(addr(http_server), "GET", "/rpcz")
+        assert b"EchoService.Echo" in resp.body
+        resp = http_fetch(addr(http_server), "GET",
+                          f"/rpcz/{client.trace_id:x}")
+        assert resp.status == 200
+
+
+# ------------------------------------------------------------------- rpc_dump
+class TestRpcDump:
+    def test_dump_and_load(self, tmp_path):
+        from brpc_tpu.trace.rpc_dump import RpcDumpLoader
+
+        _flags.set_flag("rpc_dump_ratio", "1.0")
+        try:
+            server = (Server(ServerOptions(rpc_dump_dir=str(tmp_path)))
+                      .add_service(EchoServiceImpl()).start("127.0.0.1:0"))
+            try:
+                ch = Channel().init(str(server.listen_endpoint()))
+                stub = Stub(ch, ECHO_DESC)
+                for i in range(5):
+                    stub.Echo(echo_pb2.EchoRequest(message=f"dump{i}"))
+                deadline = time.time() + 2
+                while server.rpc_dumper.sampled_count < 5 and time.time() < deadline:
+                    time.sleep(0.01)
+                server.rpc_dumper.close()
+                records = list(RpcDumpLoader(str(tmp_path)))
+                assert len(records) == 5
+                meta, body = records[0]
+                assert meta.request.service_name == "EchoService"
+                req = echo_pb2.EchoRequest()
+                req.ParseFromString(body)
+                assert req.message.startswith("dump")
+            finally:
+                server.stop()
+                server.join(timeout=2)
+        finally:
+            _flags.set_flag("rpc_dump_ratio", "0.0")
