@@ -272,3 +272,4 @@ register_builtin("threads", threads_service, "python thread stacks")
 register_builtin("memory", memory_service, "process memory stats")
 register_builtin("ids", ids_service, "live call ids")
 register_builtin("rpcz", rpcz_service, "recent rpc spans (/rpcz/<trace_id>)")
+register_builtin("logoff", logoff_service, "stop accepting new requests")

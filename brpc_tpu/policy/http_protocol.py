@@ -207,7 +207,10 @@ def parse_http_message(buf: IOBuf) -> Tuple[int, Optional[HttpMessage]]:
         msg.body, consumed = decoded
         buf.pop_front(body_start + consumed)
         return 0, msg
-    clen = int(headers.get("content-length", "0") or "0")
+    try:
+        clen = int(headers.get("content-length", "0") or "0")
+    except ValueError:
+        return PARSE_BAD, None
     if clen < 0:
         return PARSE_BAD, None
     if len(buf) < body_start + clen:
@@ -239,7 +242,8 @@ def render_request(method: str, path: str, host: str, body: bytes = b"",
     lines = [f"{method} {path} HTTP/1.1",
              f"Host: {host}",
              f"Content-Length: {len(body)}"]
-    if body:
+    if content_type:
+        # even for an empty body: the server classifies json vs pb by it
         lines.append(f"Content-Type: {content_type}")
     for k, v in (extra_headers or {}).items():
         lines.append(f"{k}: {v}")
@@ -308,15 +312,22 @@ class HttpProtocol(Protocol):
             meta.attempt_version = int(ver_s or "0")
         except ValueError:
             return
-        code = http.header(H_ERROR_CODE)
-        if code:
-            meta.response.error_code = int(code)
-            meta.response.error_text = http.header(H_ERROR_TEXT)
-        elif http.status != 200:
-            meta.response.error_code = errors.EINTERNAL
-            meta.response.error_text = f"HTTP {http.status} {http.reason}"
-        meta.compress_type = int(http.header(H_COMPRESS, "0") or "0")
-        meta.attachment_size = int(http.header(H_ATTACHMENT, "0") or "0")
+        try:
+            code = http.header(H_ERROR_CODE)
+            if code:
+                meta.response.error_code = int(code)
+                meta.response.error_text = http.header(H_ERROR_TEXT)
+            elif http.status != 200:
+                meta.response.error_code = errors.EINTERNAL
+                meta.response.error_text = f"HTTP {http.status} {http.reason}"
+            meta.compress_type = int(http.header(H_COMPRESS, "0") or "0")
+            meta.attachment_size = int(http.header(H_ATTACHMENT, "0") or "0")
+        except ValueError:
+            # malformed headers must still complete the call, not strand it
+            meta.response.error_code = errors.ERESPONSE
+            meta.response.error_text = "malformed response headers"
+            meta.compress_type = 0
+            meta.attachment_size = 0
         msg.meta = meta
         handle_response_message(msg)
 

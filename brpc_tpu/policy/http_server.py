@@ -99,10 +99,12 @@ def process_http_request(msg, server) -> None:
 
     err = None
     entry = None
+    auth_ctx = None
     try:
-        if (server.options.auth is not None
-                and not server.options.auth.verify(http.header(H_AUTH),
-                                                   sock.remote)):
+        if server.options.auth is not None:
+            auth_ctx = server.options.auth.verify_credential(
+                http.header(H_AUTH), sock.remote)
+        if server.options.auth is not None and auth_ctx is None:
             err = (errors.EAUTH, errors.error_text(errors.EAUTH))
         else:
             service = server.find_service(service_name)
@@ -131,6 +133,8 @@ def process_http_request(msg, server) -> None:
         entry.on_response(time.perf_counter_ns() // 1000 - start_us,
                           error_code)
         server.sub_concurrency()
+        if cntl.span is not None:
+            cntl.span.end(error_code)
 
     # synthesized request meta so server Controllers look protocol-uniform
     from brpc_tpu.proto import rpc_meta_pb2
@@ -144,6 +148,11 @@ def process_http_request(msg, server) -> None:
         pass
     cntl = Controller.server_controller(server, sock, meta)
     cntl.http_request = http
+    cntl.auth_context = auth_ctx
+    from brpc_tpu.trace import span as _span_mod
+
+    cntl.span = _span_mod.start_server_span(
+        meta, service_name, method_name, peer=str(sock.remote))
 
     responded = [False]
 
@@ -199,11 +208,16 @@ def process_http_request(msg, server) -> None:
             cntl.set_failed(errors.EREQUEST, f"parse request: {e}")
             return done()
 
+        from brpc_tpu.trace import span as _span
+
+        prev_span = _span.set_current(cntl.span)
         try:
             ret = entry.fn(cntl, request, done)
         except Exception as e:
             cntl.set_failed(errors.EINTERNAL, f"method raised: {e}")
             ret = None
+        finally:
+            _span.set_current(prev_span)
         if not responded[0] and (ret is not None or cntl.failed()):
             done(ret)
     except BaseException:

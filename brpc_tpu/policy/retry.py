@@ -1,0 +1,50 @@
+"""Retry policy — user-customizable "should this error retry?".
+
+Rebuild of the reference's ``retry_policy.h`` (RetryPolicy::DoRetry) and
+``backup_request_policy.h``: the Controller consults the channel's policy on
+every error-channel event; the default retries connection-level failures
+only (reference DefaultRetryPolicy — application errors and timeouts do
+not retry, a timeout means the deadline budget is already spent).
+"""
+
+from __future__ import annotations
+
+from brpc_tpu.rpc import errors
+
+
+class RetryPolicy:
+    def do_retry(self, controller) -> bool:
+        """Called with the failed controller (error_code set, call-id lock
+        held). True -> re-issue on a (possibly different) server."""
+        raise NotImplementedError
+
+
+class DefaultRetryPolicy(RetryPolicy):
+    def do_retry(self, controller) -> bool:
+        return controller.error_code in errors.DEFAULT_RETRYABLE
+
+
+class RetryOnCodes(RetryPolicy):
+    """Retry on an explicit set of codes (plus the connection-level set)."""
+
+    def __init__(self, codes, include_default: bool = True):
+        self.codes = frozenset(codes) | (
+            errors.DEFAULT_RETRYABLE if include_default else frozenset())
+
+    def do_retry(self, controller) -> bool:
+        return controller.error_code in self.codes
+
+
+class BackupRequestPolicy:
+    """Decides whether a backup (hedged) request fires for this call
+    (reference backup_request_policy.h)."""
+
+    def do_backup(self, controller) -> bool:
+        return True
+
+
+_default = DefaultRetryPolicy()
+
+
+def default_retry_policy() -> RetryPolicy:
+    return _default

@@ -32,7 +32,14 @@ from brpc_tpu.rpc.protocol import (
 MAGIC = b"TRPC"
 HEADER_FMT = "!4sII"
 HEADER_SIZE = struct.calcsize(HEADER_FMT)  # 12
-MAX_BODY_SIZE = 1 << 31
+MAX_BODY_SIZE = 1 << 31  # hard ceiling; the runtime limit is the flag
+
+
+def max_body_size() -> int:
+    """Largest accepted wire message — runtime-settable via /flags."""
+    from brpc_tpu import flags as _flags
+
+    return min(_flags.get("max_body_size"), MAX_BODY_SIZE)
 
 
 class TrpcStdProtocol(Protocol):
@@ -51,7 +58,7 @@ class TrpcStdProtocol(Protocol):
         magic, meta_size, body_size = struct.unpack(HEADER_FMT, header)
         if magic != MAGIC:
             return PARSE_TRY_OTHERS, None
-        if meta_size + body_size > MAX_BODY_SIZE:
+        if meta_size + body_size > max_body_size():
             return PARSE_BAD, None
         total = HEADER_SIZE + meta_size + body_size
         if len(buf) < total:

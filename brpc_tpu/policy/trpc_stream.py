@@ -13,7 +13,7 @@ import struct
 from typing import Optional, Tuple
 
 from brpc_tpu.butil.iobuf import IOBuf
-from brpc_tpu.policy.trpc_std import MAX_BODY_SIZE
+from brpc_tpu.policy.trpc_std import max_body_size
 from brpc_tpu.proto import rpc_meta_pb2
 from brpc_tpu.rpc.protocol import (
     PARSE_BAD,
@@ -54,7 +54,7 @@ class TrpcStreamProtocol(Protocol):
             HEADER_FMT, buf.fetch(HEADER_SIZE))
         if magic != MAGIC:
             return PARSE_TRY_OTHERS, None
-        if meta_size + body_size > MAX_BODY_SIZE:
+        if meta_size + body_size > max_body_size():
             return PARSE_BAD, None  # corrupt size field: fail the socket
         total = HEADER_SIZE + meta_size + body_size
         if len(buf) < total:

@@ -52,6 +52,9 @@ class ChannelOptions:
     backup_request_ms: int = 0  # 0 = disabled
     protocol: str = "trpc_std"
     compress_type: int = _compress.COMPRESS_NONE
+    auth: object = None           # policy/auth.py Authenticator
+    retry_policy: object = None   # policy/retry.py RetryPolicy
+    backup_request_policy: object = None  # policy/retry.py BackupRequestPolicy
     # crc32c over the body. Off by default: TCP already checksums, and the
     # pure-Python fallback is slow on MB payloads (the native core makes
     # this cheap — flip on for lossy transports).
@@ -157,6 +160,22 @@ class Channel:
         if self._lb is not None and cntl._current_socket is not None:
             self._lb.feedback(cntl._current_socket.remote,
                               cntl.error_code, cntl.latency_us)
+
+
+class RawMessage:
+    """Pre-serialized payload that rides the normal call stack — what
+    rpc_replay and generic proxies use (the reference's baidu_master_service
+    "untyped request" niche): SerializeToString/ParseFromString just pass
+    bytes through."""
+
+    def __init__(self, data: bytes = b""):
+        self.data = data
+
+    def SerializeToString(self) -> bytes:
+        return self.data
+
+    def ParseFromString(self, data: bytes) -> None:
+        self.data = data
 
 
 class RpcError(Exception):

@@ -58,6 +58,7 @@ class Controller:
         self._srv_socket = None
         self._response_sent = False
         self.http_request = None  # HttpMessage when the call arrived via http
+        self.auth_context = None  # AuthContext from the server Authenticator
         # streaming
         self.stream_id = 0            # client: stream created before call
         self._accepted_stream_id = 0  # server: stream accepted in handler
@@ -98,7 +99,8 @@ class Controller:
             from brpc_tpu.trace import span as _span
 
             self.span = _span.start_client_span(
-                method.service_name, method.method_name)
+                method.service_name, method.method_name,
+                parent=_span.current_span())
         self._call_id = _cid.id_create(data=self, on_error=_handle_id_error)
         opts = channel.options
         if self.timeout_ms is None:
@@ -139,6 +141,9 @@ class Controller:
         meta.correlation_id = cid
         meta.attempt_version = _cid.id_version(cid)
         meta.compress_type = self.compress_type
+        auth = self._channel.options.auth
+        if auth is not None:
+            meta.auth_token = auth.generate_credential()
         if self.span is not None:
             meta.request.trace_id = self.span.trace_id
             meta.request.span_id = self.span.span_id
@@ -170,12 +175,29 @@ class Controller:
             return
         if code == errors.EBACKUPREQUEST:
             # hedge: duplicate the attempt, same version — first response wins
-            if not self._backup_sent and not self.failed():
+            backup_policy = (self._channel.options.backup_request_policy
+                             if self._channel is not None else None)
+            allowed = backup_policy is None or backup_policy.do_backup(self)
+            if allowed and not self._backup_sent and not self.failed():
                 self._backup_sent = True
                 self._issue_rpc()
             _cid.id_unlock(self._call_id)
             return
-        retryable = code in errors.DEFAULT_RETRYABLE
+        # consult the channel's retry policy (reference RetryPolicy::DoRetry
+        # — runs with error_code visible on the controller)
+        prev_code = self._error_code
+        self._error_code = code
+        policy = (self._channel.options.retry_policy
+                  if self._channel is not None else None)
+        if code == errors.ERPCTIMEDOUT:
+            # the deadline budget is spent and its timer gone — a "retry"
+            # here would run with no timeout at all
+            retryable = False
+        elif policy is not None:
+            retryable = bool(policy.do_retry(self))
+        else:
+            retryable = code in errors.DEFAULT_RETRYABLE
+        self._error_code = prev_code
         if retryable and self._retry_count < (self.max_retry or 0):
             self._retry_count += 1
             _cid.id_bump_version(self._call_id)  # stale responses now dropped
@@ -195,6 +217,8 @@ class Controller:
                             meta.response.error_text)
             self._finish_locked()
             return
+        if self.span is not None:
+            self.span.response_size = len(payload) + len(attachment)
         try:
             data = _compress.decompress(payload, meta.compress_type)
             if self._response is not None:
@@ -226,12 +250,22 @@ class Controller:
         if self._current_socket is not None:
             self._current_socket.remove_pending_id(cid)
         self.latency_us = time.perf_counter_ns() // 1000 - self._start_us
+        if self._error_code != errors.OK:
+            from brpc_tpu import flags as _flags
+
+            if _flags.get("log_error_text"):
+                import logging
+
+                logging.getLogger("brpc_tpu").warning(
+                    "RPC %s.%s failed: [E%d] %s",
+                    self._method.service_name if self._method else "?",
+                    self._method.method_name if self._method else "?",
+                    self._error_code, self._error_text)
         if self.span is not None:
             if self._retry_count:
                 self.span.annotate(f"retries={self._retry_count}")
             if self._backup_sent:
                 self.span.annotate("backup request sent")
-            self.span.response_size = len(self.response_attachment)
             self.span.end(self._error_code)
         if self._channel is not None:
             self._channel._on_rpc_end(self)

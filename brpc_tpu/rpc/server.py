@@ -177,6 +177,7 @@ class Server:
         self._dispatcher.add_consumer(
             lsock.fileno(), on_readable=self._on_new_connections
         )
+        self._schedule_idle_sweep()
         return self
 
     def listen_endpoint(self) -> Optional[EndPoint]:
@@ -232,6 +233,34 @@ class Server:
             sock.register_read()
             with self._conn_lock:
                 self._connections.add(sock)
+
+    def _schedule_idle_sweep(self) -> None:
+        """Re-arming 5 s sweep closing connections idle beyond the
+        reloadable idle_timeout_s flag (ServerOptions.idle_timeout_s takes
+        precedence when >=0 was given explicitly; <=0 disables)."""
+        from brpc_tpu.fiber.timer import timer_add
+
+        def sweep() -> None:
+            if not self._running:
+                return
+            from brpc_tpu import flags as _flags
+
+            limit = self.options.idle_timeout_s
+            if limit is None or limit < 0:
+                limit = _flags.get("idle_timeout_s")
+            if limit and limit > 0:
+                import time as _time
+
+                now = _time.monotonic()
+                with self._conn_lock:
+                    idle = [c for c in self._connections
+                            if now - c.last_active > limit]
+                for c in idle:
+                    c.set_failed(errors.EFAILEDSOCKET,
+                                 f"idle > {limit:.0f}s")
+            self._schedule_idle_sweep()
+
+        timer_add(sweep, 5.0)
 
     def _on_connection_closed(self, sock: Socket) -> None:
         with self._conn_lock:

@@ -50,10 +50,15 @@ def process_rpc_request(protocol, msg, server) -> None:
     err = None
     entry = None
     try:
-        if (server.options.auth is not None
-                and not server.options.auth.verify(meta.auth_token, sock.remote)):
+        auth_ctx = None
+        if server.options.auth is not None:
+            auth_ctx = server.options.auth.verify_credential(
+                meta.auth_token, sock.remote)
+        if server.options.auth is not None and auth_ctx is None:
             err = (errors.EAUTH, "")
         else:
+            cntl.auth_context = auth_ctx
+        if err is None:
             service = server.find_service(meta.request.service_name)
             if service is None:
                 err = (errors.ENOSERVICE,
@@ -129,12 +134,16 @@ def process_rpc_request(protocol, msg, server) -> None:
             return done()
         cntl.request_attachment = attachment
 
-        # USER CODE (reference svc->CallMethod, :838-854)
+        # USER CODE (reference svc->CallMethod, :838-854); the server span
+        # is "current" while it runs so downstream calls stitch the trace
+        prev_span = _span.set_current(cntl.span)
         try:
             ret = entry.fn(cntl, request, done)
         except Exception as e:  # user bug -> EINTERNAL, not a dead connection
             cntl.set_failed(errors.EINTERNAL, f"method raised: {e}")
             ret = None
+        finally:
+            _span.set_current(prev_span)
         if not responded[0] and (ret is not None or cntl.failed()):
             done(ret)
         # else: user code kept `done` for async completion; stats settle then

@@ -64,6 +64,9 @@ class Socket:
         self.out_messages = 0
         self.user_data = None       # server conn state, stream impl, etc.
         self.owner_server = None    # set for accepted connections
+        import time as _time
+
+        self.last_active = _time.monotonic()  # idle-timeout bookkeeping
         self.socket_id = _socket_pool.insert(self)
         self._on_readable = on_readable
         self._close_lock = threading.Lock()
@@ -128,6 +131,9 @@ class Socket:
         else:
             views = [data]
         nbytes = sum(v.nbytes for v in views)
+        import time as _time
+
+        self.last_active = _time.monotonic()
         if id_wait is not None:
             self.add_pending_id(id_wait)
         claimed = False
@@ -194,6 +200,10 @@ class Socket:
             self.in_bytes += len(chunk)
             g_in_bytes.put(len(chunk))
             self.read_buf.append(chunk)
+        if total:
+            import time as _time
+
+            self.last_active = _time.monotonic()
         return total
 
     # ---------------------------------------------------------------- failure

@@ -128,6 +128,23 @@ def reset_for_test() -> None:
         _by_trace.clear()
 
 
+# ----------------------------------------------------- current span context
+# Server request processing parks its span here while user code runs, so
+# downstream client calls made inside a handler stitch into the same trace
+# (the reference parks the Span on the bthread's local storage).
+_current = threading.local()
+
+
+def set_current(span: Optional[Span]):
+    prev = getattr(_current, "span", None)
+    _current.span = span
+    return prev
+
+
+def current_span() -> Optional[Span]:
+    return getattr(_current, "span", None)
+
+
 # ------------------------------------------------------------------ creation
 def _gen_id() -> int:
     return random.getrandbits(63) | 1

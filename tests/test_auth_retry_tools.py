@@ -1,0 +1,317 @@
+"""Authenticator, retry/backup policies, and the CLI tools driven
+in-process against loopback servers (reference pattern: tools are built on
+the public API only)."""
+
+import sys
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import flags as _flags
+from brpc_tpu.policy.auth import (
+    AuthContext,
+    Authenticator,
+    SharedSecretAuthenticator,
+)
+from brpc_tpu.policy.retry import BackupRequestPolicy, RetryOnCodes, RetryPolicy
+from brpc_tpu.proto import echo_pb2
+from brpc_tpu.rpc import (
+    Channel,
+    ChannelOptions,
+    RpcError,
+    Server,
+    ServerOptions,
+    Service,
+    Stub,
+    errors,
+)
+
+ECHO_DESC = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+
+class EchoServiceImpl(Service):
+    DESCRIPTOR = ECHO_DESC
+
+    def __init__(self):
+        super().__init__()
+        self.calls = 0
+        self.seen_users = []
+        self.close_next_connection = False
+
+    def Echo(self, cntl, request, done):
+        self.calls += 1
+        if cntl.auth_context is not None:
+            self.seen_users.append(cntl.auth_context.user)
+        if self.close_next_connection:
+            self.close_next_connection = False
+            cntl._srv_socket.set_failed(errors.EFAILEDSOCKET, "injected")
+            return None
+        if request.sleep_us:
+            time.sleep(request.sleep_us / 1e6)
+        return echo_pb2.EchoResponse(message=request.message)
+
+
+def start_server(**opts):
+    impl = EchoServiceImpl()
+    server = Server(ServerOptions(**opts)).add_service(impl)
+    server.start("127.0.0.1:0")
+    return server, impl
+
+
+# ------------------------------------------------------------------------ auth
+class TestAuth:
+    def test_shared_secret_ok(self):
+        auth = SharedSecretAuthenticator(b"s3cret", user="alice")
+        server, impl = start_server(auth=SharedSecretAuthenticator(b"s3cret"))
+        try:
+            ch = Channel(ChannelOptions(auth=auth)).init(
+                str(server.listen_endpoint()))
+            stub = Stub(ch, ECHO_DESC)
+            assert stub.Echo(echo_pb2.EchoRequest(message="m")).message == "m"
+            assert impl.seen_users == ["alice"]
+        finally:
+            server.stop(); server.join(timeout=2)
+
+    def test_wrong_secret_rejected(self):
+        server, _ = start_server(auth=SharedSecretAuthenticator(b"right"))
+        try:
+            ch = Channel(ChannelOptions(
+                auth=SharedSecretAuthenticator(b"wrong"),
+                max_retry=0)).init(str(server.listen_endpoint()))
+            stub = Stub(ch, ECHO_DESC)
+            with pytest.raises(RpcError) as ei:
+                stub.Echo(echo_pb2.EchoRequest(message="m"))
+            assert ei.value.error_code == errors.EAUTH
+        finally:
+            server.stop(); server.join(timeout=2)
+
+    def test_missing_credential_rejected(self):
+        server, _ = start_server(auth=SharedSecretAuthenticator(b"k"))
+        try:
+            ch = Channel(ChannelOptions(max_retry=0)).init(
+                str(server.listen_endpoint()))
+            stub = Stub(ch, ECHO_DESC)
+            with pytest.raises(RpcError) as ei:
+                stub.Echo(echo_pb2.EchoRequest(message="m"))
+            assert ei.value.error_code == errors.EAUTH
+        finally:
+            server.stop(); server.join(timeout=2)
+
+    def test_auth_over_http(self):
+        auth = SharedSecretAuthenticator(b"k", user="bob")
+        server, impl = start_server(auth=SharedSecretAuthenticator(b"k"))
+        try:
+            ch = Channel(ChannelOptions(auth=auth, protocol="http")).init(
+                str(server.listen_endpoint()))
+            stub = Stub(ch, ECHO_DESC)
+            assert stub.Echo(echo_pb2.EchoRequest(message="h")).message == "h"
+            assert impl.seen_users == ["bob"]
+        finally:
+            server.stop(); server.join(timeout=2)
+
+    def test_custom_authenticator(self):
+        class AllowEven(Authenticator):
+            def __init__(self):
+                self.n = 0
+
+            def generate_credential(self):
+                self.n += 1
+                return str(self.n)
+
+            def verify_credential(self, token, peer):
+                try:
+                    return (AuthContext(user=f"u{token}")
+                            if int(token) % 2 == 0 else None)
+                except ValueError:
+                    return None
+
+        server, _ = start_server(auth=AllowEven())
+        try:
+            ch = Channel(ChannelOptions(auth=AllowEven(), max_retry=0)).init(
+                str(server.listen_endpoint()))
+            stub = Stub(ch, ECHO_DESC)
+            with pytest.raises(RpcError):  # first credential "1" is odd
+                stub.Echo(echo_pb2.EchoRequest(message="m"))
+            assert stub.Echo(echo_pb2.EchoRequest(message="m")).message == "m"
+        finally:
+            server.stop(); server.join(timeout=2)
+
+
+# ---------------------------------------------------------------- retry policy
+class TestRetryPolicy:
+    def test_no_retry_policy_fails_fast(self):
+        class NeverRetry(RetryPolicy):
+            def do_retry(self, cntl):
+                return False
+
+        server, impl = start_server()
+        try:
+            ch = Channel(ChannelOptions(
+                max_retry=3, retry_policy=NeverRetry())).init(
+                str(server.listen_endpoint()))
+            stub = Stub(ch, ECHO_DESC)
+            impl.close_next_connection = True
+            with pytest.raises(RpcError) as ei:
+                stub.Echo(echo_pb2.EchoRequest(message="m"))
+            assert ei.value.error_code == errors.EFAILEDSOCKET
+            assert impl.calls == 1  # no second attempt
+        finally:
+            server.stop(); server.join(timeout=2)
+
+    def test_default_policy_retries_socket_failure(self):
+        server, impl = start_server()
+        try:
+            ch = Channel(ChannelOptions(max_retry=3)).init(
+                str(server.listen_endpoint()))
+            stub = Stub(ch, ECHO_DESC)
+            impl.close_next_connection = True
+            assert stub.Echo(echo_pb2.EchoRequest(message="m")).message == "m"
+            assert impl.calls == 2
+        finally:
+            server.stop(); server.join(timeout=2)
+
+    def test_retry_on_codes_set(self):
+        policy = RetryOnCodes({errors.EINTERNAL}, include_default=False)
+
+        class FakeCntl:
+            error_code = errors.EINTERNAL
+
+        assert policy.do_retry(FakeCntl())
+        FakeCntl.error_code = errors.EFAILEDSOCKET
+        assert not policy.do_retry(FakeCntl())
+
+    def test_backup_policy_vetoes_hedge(self):
+        class NoBackup(BackupRequestPolicy):
+            def do_backup(self, cntl):
+                return False
+
+        server, impl = start_server()
+        try:
+            ch = Channel(ChannelOptions(
+                backup_request_ms=20,
+                backup_request_policy=NoBackup(),
+                timeout_ms=2000)).init(str(server.listen_endpoint()))
+            stub = Stub(ch, ECHO_DESC)
+            resp = stub.Echo(echo_pb2.EchoRequest(message="m", sleep_us=100_000))
+            assert resp.message == "m"
+            time.sleep(0.05)
+            assert impl.calls == 1  # hedge suppressed
+        finally:
+            server.stop(); server.join(timeout=2)
+
+    def test_backup_fires_by_default(self):
+        server, impl = start_server()
+        try:
+            ch = Channel(ChannelOptions(
+                backup_request_ms=20, timeout_ms=2000)).init(
+                str(server.listen_endpoint()))
+            stub = Stub(ch, ECHO_DESC)
+            resp = stub.Echo(echo_pb2.EchoRequest(message="m", sleep_us=100_000))
+            assert resp.message == "m"
+            time.sleep(0.2)
+            assert impl.calls == 2  # original + hedge
+        finally:
+            server.stop(); server.join(timeout=2)
+
+
+# ------------------------------------------------------------- trace stitching
+class TestTraceStitching:
+    def test_two_hop_trace_shares_trace_id(self):
+        from brpc_tpu.trace import span as _span
+
+        _span.reset_for_test()
+        backend, _ = start_server()
+
+        class ProxyService(Service):
+            DESCRIPTOR = ECHO_DESC
+
+            def __init__(self, downstream):
+                super().__init__()
+                self._stub = Stub(downstream, ECHO_DESC)
+
+            def Echo(self, cntl, request, done):
+                # downstream call inside the handler must join the trace
+                return self._stub.Echo(request)
+
+        down = Channel().init(str(backend.listen_endpoint()))
+        proxy = Server().add_service(ProxyService(down)).start("127.0.0.1:0")
+        try:
+            stub = Stub(Channel().init(str(proxy.listen_endpoint())), ECHO_DESC)
+            assert stub.Echo(echo_pb2.EchoRequest(message="hop")).message == "hop"
+            deadline = time.time() + 2
+            while time.time() < deadline:
+                spans = _span.recent_spans(20)
+                if len(spans) >= 4:
+                    break
+                time.sleep(0.01)
+            trace_ids = {s.trace_id for s in spans}
+            assert len(spans) >= 4  # client, proxy-server, proxy-client, backend
+            assert len(trace_ids) == 1, "all hops share one trace"
+        finally:
+            proxy.stop(); proxy.join(timeout=2)
+            backend.stop(); backend.join(timeout=2)
+
+
+# ---------------------------------------------------------------------- tools
+class TestTools:
+    def test_rpc_press(self, capsys):
+        sys.path.insert(0, "tools")
+        from tools import rpc_press  # noqa
+
+        server, impl = start_server()
+        try:
+            rc = rpc_press.main([
+                "--server", str(server.listen_endpoint()),
+                "--qps", "200", "--duration", "0.5", "--quiet"])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "latency_p99_us" in out
+            assert impl.calls > 10
+        finally:
+            server.stop(); server.join(timeout=2)
+
+    def test_rpc_dump_then_replay(self, tmp_path, capsys):
+        from tools import rpc_replay
+
+        _flags.set_flag("rpc_dump_ratio", "1.0")
+        try:
+            server, impl = start_server(rpc_dump_dir=str(tmp_path))
+            try:
+                ch = Channel().init(str(server.listen_endpoint()))
+                stub = Stub(ch, ECHO_DESC)
+                for i in range(3):
+                    stub.Echo(echo_pb2.EchoRequest(message=f"r{i}"))
+                deadline = time.time() + 2
+                while (server.rpc_dumper.sampled_count < 3
+                       and time.time() < deadline):
+                    time.sleep(0.01)
+                server.rpc_dumper.close()
+            finally:
+                server.stop(); server.join(timeout=2)
+            _flags.set_flag("rpc_dump_ratio", "0.0")
+
+            # replay the dump into a fresh server
+            server2, impl2 = start_server()
+            try:
+                rc = rpc_replay.main([
+                    "--dump", str(tmp_path),
+                    "--server", str(server2.listen_endpoint())])
+                assert rc == 0
+                assert impl2.calls == 3
+                assert "replayed ok 3 failed 0" in capsys.readouterr().out
+            finally:
+                server2.stop(); server2.join(timeout=2)
+        finally:
+            _flags.set_flag("rpc_dump_ratio", "0.0")
+
+    def test_rpc_view(self, capsys):
+        from tools import rpc_view
+
+        server, _ = start_server()
+        try:
+            rc = rpc_view.main([str(server.listen_endpoint()), "status"])
+            assert rc == 0
+            assert "EchoService" in capsys.readouterr().out
+        finally:
+            server.stop(); server.join(timeout=2)

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""rpc_press — load generator (counterpart of the reference tools/rpc_press).
+
+Drives a target server at a fixed QPS (or flat-out with --qps 0) using async
+calls, printing per-second throughput and a latency summary. The request is
+an EchoService/Echo by default; --service/--method with --body-json works
+for any registered pb service via the HTTP protocol, or raw bytes via
+--body-file over trpc_std.
+
+Example:
+    python tools/rpc_press.py --server 127.0.0.1:8000 --qps 5000 --duration 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from brpc_tpu.metrics.latency_recorder import LatencyRecorder
+from brpc_tpu.rpc import Channel, ChannelOptions, Controller, MethodDescriptor
+from brpc_tpu.rpc.channel import RawMessage
+
+
+def build_method(args) -> tuple:
+    if args.body_file:
+        with open(args.body_file, "rb") as f:
+            body = f.read()
+        md = MethodDescriptor(args.service, args.method,
+                              request_class=None, response_class=RawMessage)
+        return md, RawMessage(body)
+    from brpc_tpu.proto import echo_pb2
+
+    md = MethodDescriptor.from_pb(
+        echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+        .methods_by_name["Echo"])
+    return md, echo_pb2.EchoRequest(message="x" * args.payload_size)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--server", required=True, help="host:port")
+    p.add_argument("--qps", type=int, default=1000,
+                   help="target rate; 0 = as fast as possible")
+    p.add_argument("--duration", type=float, default=10.0, help="seconds")
+    p.add_argument("--concurrency", type=int, default=64,
+                   help="max in-flight calls")
+    p.add_argument("--timeout-ms", type=int, default=1000)
+    p.add_argument("--protocol", default="trpc_std")
+    p.add_argument("--service", default="EchoService")
+    p.add_argument("--method", default="Echo")
+    p.add_argument("--payload-size", type=int, default=16)
+    p.add_argument("--body-file", default=None,
+                   help="raw serialized request body")
+    p.add_argument("--quiet", action="store_true")
+    args = p.parse_args(argv)
+
+    channel = Channel(ChannelOptions(
+        timeout_ms=args.timeout_ms, protocol=args.protocol,
+        max_retry=0)).init(args.server)
+    method, request = build_method(args)
+
+    recorder = LatencyRecorder()
+    sent = [0]
+    errors_count = [0]
+    inflight = threading.Semaphore(args.concurrency)
+    stop_at = time.monotonic() + args.duration
+    done_all = threading.Event()
+    pending = [0]
+    pending_lock = threading.Lock()
+
+    def on_done(cntl: Controller) -> None:
+        if cntl.failed():
+            errors_count[0] += 1
+        else:
+            recorder.record(cntl.latency_us)
+        inflight.release()
+        with pending_lock:
+            pending[0] -= 1
+            if pending[0] == 0 and time.monotonic() >= stop_at:
+                done_all.set()
+
+    interval = 1.0 / args.qps if args.qps > 0 else 0.0
+    next_fire = time.monotonic()
+    last_report = time.monotonic()
+    while time.monotonic() < stop_at:
+        if interval:
+            now = time.monotonic()
+            if now < next_fire:
+                time.sleep(min(next_fire - now, 0.01))
+                continue
+            next_fire += interval
+        inflight.acquire()
+        with pending_lock:
+            pending[0] += 1
+        sent[0] += 1
+        resp = method.response_class() if method.response_class else None
+        channel.call_method(method, request, response=resp, done=on_done)
+        now = time.monotonic()
+        if not args.quiet and now - last_report >= 1.0:
+            last_report = now
+            print(f"sent={sent[0]} qps={recorder.qps():.0f} "
+                  f"avg={recorder.latency():.0f}us "
+                  f"p99={recorder.latency_percentile(0.99):.0f}us "
+                  f"errors={errors_count[0]}", file=sys.stderr)
+    done_all.wait(timeout=args.timeout_ms / 1000.0 + 1.0)
+
+    total = recorder.count()
+    print(f"sent {sent[0]} ok {total} errors {errors_count[0]}")
+    print(f"latency_avg_us {recorder.latency():.1f}")
+    for q in (0.5, 0.9, 0.99, 0.999):
+        print(f"latency_p{int(q * 1000) / 10:g}_us "
+              f"{recorder.latency_percentile(q):.1f}")
+    return 0 if errors_count[0] == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

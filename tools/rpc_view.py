@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""rpc_view — inspect a running server's builtin pages from the CLI
+(counterpart of the reference tools/rpc_view, which proxies builtin
+services of a remote server).
+
+Example:
+    python tools/rpc_view.py 127.0.0.1:8000 status
+    python tools/rpc_view.py 127.0.0.1:8000 flags/idle_timeout_s
+    python tools/rpc_view.py 127.0.0.1:8000 flags/idle_timeout_s --set 30
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from brpc_tpu.policy.http_protocol import http_fetch
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("server", help="host:port")
+    p.add_argument("page", nargs="?", default="status",
+                   help="builtin page path (default: status)")
+    p.add_argument("--set", dest="setvalue", default=None,
+                   help="set a flag value (page must be flags/<name>)")
+    p.add_argument("--timeout", type=float, default=5.0)
+    args = p.parse_args(argv)
+
+    path = "/" + args.page.lstrip("/")
+    if args.setvalue is not None:
+        path += f"?setvalue={args.setvalue}"
+    try:
+        resp = http_fetch(args.server, "GET", path, timeout=args.timeout)
+    except (OSError, ValueError) as e:
+        print(f"cannot reach {args.server}: {e}", file=sys.stderr)
+        return 1
+    sys.stdout.write(resp.body.decode("utf-8", errors="replace"))
+    return 0 if resp.status == 200 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
