@@ -1,0 +1,142 @@
+"""native — the C++ core, built on first import and loaded via ctypes.
+
+The reference is native C++ throughout (SURVEY §2); our compute path is
+JAX/XLA, but the runtime hot paths (checksums, rand, wire-frame scanning —
+and, growing over time, the transport loop) are C++ here too. The build is
+a single ``g++ -O3 -shared`` invocation cached next to the source; when no
+toolchain is available every caller falls back to the pure-Python
+implementation transparently.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "core.cpp")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_error: Optional[str] = None
+
+
+def _build_flags():
+    flags = ["-O3", "-shared", "-fPIC", "-std=c++17"]
+    import platform
+
+    if platform.machine() in ("x86_64", "AMD64"):
+        flags.append("-msse4.2")
+    return flags
+
+
+def _so_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(_DIR, f"_core_{digest}.so")
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Build (if needed) and load the native core; None on failure."""
+    global _lib, _build_error
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            return None
+        so = _so_path()
+        if not os.path.exists(so):
+            tmp = so + ".tmp"
+            cmd = ["g++", *_build_flags(), _SRC, "-o", tmp]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+                os.replace(tmp, so)
+            except (OSError, subprocess.SubprocessError) as e:
+                _build_error = f"{type(e).__name__}: {e}"
+                return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError as e:
+            _build_error = str(e)
+            return None
+        lib.tn_crc32c.restype = ctypes.c_uint32
+        lib.tn_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                  ctypes.c_uint32]
+        lib.tn_fast_rand.restype = ctypes.c_uint64
+        lib.tn_fast_rand.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+        lib.tn_fast_rand_less_than.restype = ctypes.c_uint64
+        lib.tn_fast_rand_less_than.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
+        lib.tn_frame_scan.restype = ctypes.c_int
+        lib.tn_frame_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.tn_abi_version.restype = ctypes.c_int
+        if lib.tn_abi_version() != 1:
+            _build_error = "abi mismatch"
+            return None
+        _lib = lib
+        return _lib
+
+
+def build_error() -> Optional[str]:
+    return _build_error
+
+
+# ------------------------------------------------------------- installation
+def install() -> bool:
+    """Point the Python fallbacks at the native implementations.
+    Returns True when the native core is active."""
+    lib = load()
+    if lib is None:
+        return False
+    from brpc_tpu.butil import misc
+
+    def native_crc32c(data, value: int = 0) -> int:
+        b = bytes(data)
+        return lib.tn_crc32c(b, len(b), value)
+
+    misc._native_crc32c = native_crc32c
+
+    state = ctypes.c_uint64(0x9E3779B97F4A7C15)
+
+    def native_fast_rand() -> int:
+        return lib.tn_fast_rand(ctypes.byref(state))
+
+    def native_fast_rand_less_than(n: int) -> int:
+        return lib.tn_fast_rand_less_than(ctypes.byref(state), n)
+
+    misc.fast_rand = native_fast_rand
+    misc.fast_rand_less_than = native_fast_rand_less_than
+    return True
+
+
+class FrameScanner:
+    """Batched TRPC/TSTR frame-boundary scanner over a contiguous buffer."""
+
+    def __init__(self, max_frames: int = 128):
+        self._lib = load()
+        self.max_frames = max_frames
+        self._offsets = (ctypes.c_uint64 * (3 * max_frames))()
+        self._consumed = ctypes.c_uint64()
+
+    @property
+    def available(self) -> bool:
+        return self._lib is not None
+
+    def scan(self, data: bytes, max_body: int):
+        """Returns (frames, consumed, bad) where frames is a list of
+        (start, meta_size, body_size) for each COMPLETE frame."""
+        n = self._lib.tn_frame_scan(
+            data, len(data), max_body, self._offsets, self.max_frames,
+            ctypes.byref(self._consumed))
+        bad = n < 0
+        frames = [(self._offsets[i * 3], self._offsets[i * 3 + 1],
+                   self._offsets[i * 3 + 2]) for i in range(max(n, 0))]
+        return frames, self._consumed.value, bad

@@ -26,4 +26,10 @@ def ensure_registered() -> None:
         register_protocol(TrpcStdProtocol())
         register_protocol(TrpcStreamProtocol())
         register_protocol(HttpProtocol())  # probed last: magic-less
+        try:  # activate the C++ core (crc32c/fast_rand); fall back silently
+            from brpc_tpu import native
+
+            native.install()
+        except Exception:
+            pass
         _done = True

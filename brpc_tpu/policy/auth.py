@@ -43,14 +43,11 @@ class Authenticator:
 
     def verify_credential(self, token: str,
                           peer) -> Optional[AuthContext]:
-        """Server side: return an AuthContext to accept, None to reject."""
+        """Server side — THE framework entry point: return an AuthContext
+        to accept (it becomes ``cntl.auth_context``), None to reject.
+        Called concurrently from request-processing fibers; implementations
+        must be thread-safe and must not stash per-request state on self."""
         raise NotImplementedError
-
-    # ------------------------------------------------ framework entry point
-    def verify(self, token: str, peer) -> bool:
-        ctx = self.verify_credential(token, peer)
-        self.last_context = ctx
-        return ctx is not None
 
 
 class SharedSecretAuthenticator(Authenticator):

@@ -89,12 +89,33 @@ def process_http_request(msg, server) -> None:
         return _rpc_error_reply(sock, http, errors.ENOSERVICE,
                                 f"no such path {http.path!r}", as_json)
     service_name, method_name = parts
+
+    # synthesized request meta so server Controllers look protocol-uniform;
+    # created before admission so rejections reach /rpcz like the binary path
+    from brpc_tpu.proto import rpc_meta_pb2
+    from brpc_tpu.trace import span as _span_mod
+
+    meta = rpc_meta_pb2.RpcMeta()
+    meta.request.service_name = service_name
+    meta.request.method_name = method_name
+    try:
+        meta.request.log_id = int(http.header(H_LOG_ID, "0") or "0")
+    except ValueError:
+        pass
+    cntl = Controller.server_controller(server, sock, meta)
+    cntl.http_request = http
+    cntl.span = _span_mod.start_server_span(
+        meta, service_name, method_name, peer=str(sock.remote))
+
+    def reject(code: int, text: str) -> None:
+        if cntl.span is not None:
+            cntl.span.end(code)
+        _rpc_error_reply(sock, http, code, text, as_json)
+
     if not server.is_running:
-        return _rpc_error_reply(sock, http, errors.ELOGOFF,
-                                errors.error_text(errors.ELOGOFF), as_json)
+        return reject(errors.ELOGOFF, errors.error_text(errors.ELOGOFF))
     if not server.add_concurrency():
-        return _rpc_error_reply(sock, http, errors.ELIMIT,
-                                "server max_concurrency reached", as_json)
+        return reject(errors.ELIMIT, "server max_concurrency reached")
     start_us = time.perf_counter_ns() // 1000
 
     err = None
@@ -107,6 +128,7 @@ def process_http_request(msg, server) -> None:
         if server.options.auth is not None and auth_ctx is None:
             err = (errors.EAUTH, errors.error_text(errors.EAUTH))
         else:
+            cntl.auth_context = auth_ctx
             service = server.find_service(service_name)
             if service is None:
                 err = (errors.ENOSERVICE, f"no service {service_name!r}")
@@ -122,7 +144,7 @@ def process_http_request(msg, server) -> None:
         raise
     if entry is None:
         server.sub_concurrency()
-        return _rpc_error_reply(sock, http, *err, as_json)
+        return reject(*err)
 
     settled = [False]
 
@@ -135,24 +157,6 @@ def process_http_request(msg, server) -> None:
         server.sub_concurrency()
         if cntl.span is not None:
             cntl.span.end(error_code)
-
-    # synthesized request meta so server Controllers look protocol-uniform
-    from brpc_tpu.proto import rpc_meta_pb2
-
-    meta = rpc_meta_pb2.RpcMeta()
-    meta.request.service_name = service_name
-    meta.request.method_name = method_name
-    try:
-        meta.request.log_id = int(http.header(H_LOG_ID, "0") or "0")
-    except ValueError:
-        pass
-    cntl = Controller.server_controller(server, sock, meta)
-    cntl.http_request = http
-    cntl.auth_context = auth_ctx
-    from brpc_tpu.trace import span as _span_mod
-
-    cntl.span = _span_mod.start_server_span(
-        meta, service_name, method_name, peer=str(sock.remote))
 
     responded = [False]
 

@@ -177,7 +177,11 @@ class Controller:
             # hedge: duplicate the attempt, same version — first response wins
             backup_policy = (self._channel.options.backup_request_policy
                              if self._channel is not None else None)
-            allowed = backup_policy is None or backup_policy.do_backup(self)
+            try:
+                allowed = (backup_policy is None
+                           or backup_policy.do_backup(self))
+            except Exception:  # buggy user policy must not wedge the id lock
+                allowed = False
             if allowed and not self._backup_sent and not self.failed():
                 self._backup_sent = True
                 self._issue_rpc()
@@ -194,7 +198,10 @@ class Controller:
             # here would run with no timeout at all
             retryable = False
         elif policy is not None:
-            retryable = bool(policy.do_retry(self))
+            try:
+                retryable = bool(policy.do_retry(self))
+            except Exception:  # buggy user policy -> no retry, finish the RPC
+                retryable = False
         else:
             retryable = code in errors.DEFAULT_RETRYABLE
         self._error_code = prev_code

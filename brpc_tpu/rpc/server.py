@@ -136,6 +136,7 @@ class Server:
         self.concurrency = 0
         self._concurrency_lock = threading.Lock()
         self.requests_processed = Adder()
+        self._idle_sweep_timer = None
         self.rpc_dumper = None
         if self.options.rpc_dump_dir:
             from brpc_tpu.trace.rpc_dump import RpcDumper
@@ -186,6 +187,11 @@ class Server:
     def stop(self) -> None:
         """Graceful: reject new requests (ELOGOFF), keep serving in-flight."""
         self._logoff = True
+        if self._idle_sweep_timer is not None:
+            from brpc_tpu.fiber.timer import timer_del
+
+            timer_del(self._idle_sweep_timer)
+            self._idle_sweep_timer = None
         if self._listen_sock is not None:
             try:
                 self._dispatcher.remove_consumer(self._listen_sock.fileno())
@@ -237,12 +243,14 @@ class Server:
     def _schedule_idle_sweep(self) -> None:
         """Re-arming 5 s sweep closing connections idle beyond the
         reloadable idle_timeout_s flag (ServerOptions.idle_timeout_s takes
-        precedence when >=0 was given explicitly; <=0 disables)."""
+        precedence when >=0 was given explicitly; <=0 disables). stop()
+        cancels the chain via the stored timer id."""
         from brpc_tpu.fiber.timer import timer_add
 
         def sweep() -> None:
-            if not self._running:
-                return
+            if not self._running or self._logoff:
+                return  # stop() cancels the chain; a mid-flight sweep
+                        # must not resurrect it
             from brpc_tpu import flags as _flags
 
             limit = self.options.idle_timeout_s
@@ -260,7 +268,7 @@ class Server:
                                  f"idle > {limit:.0f}s")
             self._schedule_idle_sweep()
 
-        timer_add(sweep, 5.0)
+        self._idle_sweep_timer = timer_add(sweep, 5.0)
 
     def _on_connection_closed(self, sock: Socket) -> None:
         with self._conn_lock:

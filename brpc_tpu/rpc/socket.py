@@ -19,6 +19,7 @@ from __future__ import annotations
 import errno as _errno
 import socket as _socket
 import threading
+import time as _time
 from collections import deque
 from typing import Callable, Optional, Set
 
@@ -64,8 +65,6 @@ class Socket:
         self.out_messages = 0
         self.user_data = None       # server conn state, stream impl, etc.
         self.owner_server = None    # set for accepted connections
-        import time as _time
-
         self.last_active = _time.monotonic()  # idle-timeout bookkeeping
         self.socket_id = _socket_pool.insert(self)
         self._on_readable = on_readable
@@ -131,8 +130,6 @@ class Socket:
         else:
             views = [data]
         nbytes = sum(v.nbytes for v in views)
-        import time as _time
-
         self.last_active = _time.monotonic()
         if id_wait is not None:
             self.add_pending_id(id_wait)
@@ -201,8 +198,6 @@ class Socket:
             g_in_bytes.put(len(chunk))
             self.read_buf.append(chunk)
         if total:
-            import time as _time
-
             self.last_active = _time.monotonic()
         return total
 

@@ -1,0 +1,88 @@
+"""Native C++ core: build, correctness vs the pure-Python fallbacks, and
+the wire-frame scanner's conformance with the trpc_std framing."""
+
+import struct
+
+import pytest
+
+from brpc_tpu import native
+from brpc_tpu.butil import misc
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.load()
+    if lib is None:
+        pytest.skip(f"native core unavailable: {native.build_error()}")
+    return lib
+
+
+class TestCrc32c:
+    def test_matches_python(self, lib):
+        native.install()
+        try:
+            for data in (b"", b"a", b"hello world", bytes(range(256)) * 33):
+                got = misc.crc32c(data)
+                misc._native_crc32c, saved = None, misc._native_crc32c
+                try:
+                    want = misc.crc32c(data)
+                finally:
+                    misc._native_crc32c = saved
+                assert got == want, data[:16]
+        finally:
+            native.install()
+
+    def test_known_vector(self, lib):
+        native.install()
+        # RFC 3720 test vector: crc32c of 32 zero bytes
+        assert misc.crc32c(b"\x00" * 32) == 0x8A9136AA
+
+    def test_chaining(self, lib):
+        native.install()
+        a = misc.crc32c(b"hello ")
+        assert misc.crc32c(b"world", a) == misc.crc32c(b"hello world")
+
+
+class TestFastRand:
+    def test_distribution_sane(self, lib):
+        native.install()
+        vals = [misc.fast_rand() for _ in range(2000)]
+        assert len(set(vals)) == 2000
+        assert all(misc.fast_rand_less_than(7) < 7 for _ in range(500))
+        assert misc.fast_rand_less_than(0) == 0
+
+
+class TestFrameScanner:
+    def make_frame(self, magic=b"TRPC", meta=b"m" * 5, body=b"b" * 9):
+        return magic + struct.pack("!II", len(meta), len(body)) + meta + body
+
+    def test_scan_complete_frames(self, lib):
+        sc = native.FrameScanner()
+        f1, f2 = self.make_frame(), self.make_frame(magic=b"TSTR", body=b"x")
+        frames, consumed, bad = sc.scan(f1 + f2, 1 << 31)
+        assert not bad
+        assert frames == [(0, 5, 9), (len(f1), 5, 1)]
+        assert consumed == len(f1) + len(f2)
+
+    def test_incomplete_tail(self, lib):
+        sc = native.FrameScanner()
+        f1 = self.make_frame()
+        frames, consumed, bad = sc.scan(f1 + f1[: len(f1) - 1], 1 << 31)
+        assert not bad and len(frames) == 1 and consumed == len(f1)
+
+    def test_bad_magic(self, lib):
+        sc = native.FrameScanner()
+        frames, consumed, bad = sc.scan(b"NOPE" + b"\x00" * 20, 1 << 31)
+        assert bad and consumed == 0
+
+    def test_oversized_frame_rejected(self, lib):
+        sc = native.FrameScanner()
+        f = self.make_frame(body=b"y" * 100)
+        frames, consumed, bad = sc.scan(f, 50)
+        assert bad
+
+    def test_max_frames_cap(self, lib):
+        sc = native.FrameScanner(max_frames=2)
+        f = self.make_frame()
+        frames, consumed, bad = sc.scan(f * 5, 1 << 31)
+        assert len(frames) == 2 and consumed == 2 * len(f) and not bad

@@ -3,9 +3,8 @@
 
 Drives a target server at a fixed QPS (or flat-out with --qps 0) using async
 calls, printing per-second throughput and a latency summary. The request is
-an EchoService/Echo by default; --service/--method with --body-json works
-for any registered pb service via the HTTP protocol, or raw bytes via
---body-file over trpc_std.
+an EchoService/Echo by default; any other service/method takes a
+pre-serialized request body via --service/--method/--body-file.
 
 Example:
     python tools/rpc_press.py --server 127.0.0.1:8000 --qps 5000 --duration 10
@@ -70,6 +69,7 @@ def main(argv=None) -> int:
     inflight = threading.Semaphore(args.concurrency)
     stop_at = time.monotonic() + args.duration
     done_all = threading.Event()
+    sender_done = [False]
     pending = [0]
     pending_lock = threading.Lock()
 
@@ -81,7 +81,7 @@ def main(argv=None) -> int:
         inflight.release()
         with pending_lock:
             pending[0] -= 1
-            if pending[0] == 0 and time.monotonic() >= stop_at:
+            if pending[0] == 0 and sender_done[0]:
                 done_all.set()
 
     interval = 1.0 / args.qps if args.qps > 0 else 0.0
@@ -107,6 +107,10 @@ def main(argv=None) -> int:
                   f"avg={recorder.latency():.0f}us "
                   f"p99={recorder.latency_percentile(0.99):.0f}us "
                   f"errors={errors_count[0]}", file=sys.stderr)
+    with pending_lock:
+        sender_done[0] = True
+        if pending[0] == 0:
+            done_all.set()
     done_all.wait(timeout=args.timeout_ms / 1000.0 + 1.0)
 
     total = recorder.count()
