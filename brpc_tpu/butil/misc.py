@@ -43,13 +43,21 @@ def crc32c(data, value: int = 0) -> int:
 
 # --------------------------------------------------------------- fast_rand
 _rng = random.Random()
+# hook pattern (same as _native_crc32c): callers `from`-import these
+# functions, so the native core installs via indirection, not rebinding
+_native_fast_rand = None
+_native_fast_rand_less_than = None
 
 
 def fast_rand() -> int:
+    if _native_fast_rand is not None:
+        return _native_fast_rand()
     return _rng.getrandbits(64)
 
 
 def fast_rand_less_than(n: int) -> int:
+    if _native_fast_rand_less_than is not None:
+        return _native_fast_rand_less_than(n)
     return _rng.randrange(n) if n > 0 else 0
 
 

@@ -104,16 +104,19 @@ def install() -> bool:
 
     misc._native_crc32c = native_crc32c
 
-    state = ctypes.c_uint64(0x9E3779B97F4A7C15)
+    # entropy-seeded, like the Python fallback (identical sequences across
+    # a fleet would synchronize "random" LB picks and jitter)
+    state = ctypes.c_uint64(
+        int.from_bytes(os.urandom(8), "little") | 1)
 
     def native_fast_rand() -> int:
         return lib.tn_fast_rand(ctypes.byref(state))
 
     def native_fast_rand_less_than(n: int) -> int:
-        return lib.tn_fast_rand_less_than(ctypes.byref(state), n)
+        return lib.tn_fast_rand_less_than(ctypes.byref(state), n) if n > 0 else 0
 
-    misc.fast_rand = native_fast_rand
-    misc.fast_rand_less_than = native_fast_rand_less_than
+    misc._native_fast_rand = native_fast_rand
+    misc._native_fast_rand_less_than = native_fast_rand_less_than
     return True
 
 

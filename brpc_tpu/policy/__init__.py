@@ -22,9 +22,13 @@ def ensure_registered() -> None:
         from brpc_tpu.policy.trpc_std import TrpcStdProtocol
         from brpc_tpu.policy.trpc_stream import TrpcStreamProtocol
         from brpc_tpu.policy.http_protocol import HttpProtocol
+        from brpc_tpu.policy.grpc_protocol import GrpcProtocol
 
         register_protocol(TrpcStdProtocol())
         register_protocol(TrpcStreamProtocol())
+        # grpc before http: the h2 preface ("PRI * HTTP/2.0...") would
+        # otherwise parse as an HTTP/1 request-line
+        register_protocol(GrpcProtocol())
         register_protocol(HttpProtocol())  # probed last: magic-less
         try:  # activate the C++ core (crc32c/fast_rand); fall back silently
             from brpc_tpu import native

@@ -151,8 +151,12 @@ class Channel:
             from brpc_tpu.tpu.tpusocket import get_tpu_socket
 
             return get_tpu_socket(ep)
+        # connection-scoped protocols (h2/grpc) can't share a socket with
+        # frame protocols — key the shared map by protocol family
+        signature = "h2" if hasattr(self._protocol, "issue_request") else ""
         return self._socket_map.get_or_create(
-            ep, connect_timeout=self.options.connect_timeout_ms / 1000.0
+            ep, connect_timeout=self.options.connect_timeout_ms / 1000.0,
+            signature=signature,
         )
 
     def _on_rpc_end(self, cntl: Controller) -> None:

@@ -158,11 +158,19 @@ class Controller:
         payload = _compress.compress(
             self._request.SerializeToString(), self.compress_type
         )
-        packet = self._channel._protocol.pack_request(
-            meta, payload, self.request_attachment,
-            checksum=self._channel.options.enable_checksum,
-        )
-        rc = sock.write(packet, id_wait=cid)
+        proto = self._channel._protocol
+        if hasattr(proto, "issue_request"):
+            # connection-scoped protocols (grpc/h2) pack+write themselves:
+            # stream allocation and HPACK emission need the socket
+            rc = proto.issue_request(
+                sock, meta, payload, self.request_attachment,
+                checksum=self._channel.options.enable_checksum, id_wait=cid)
+        else:
+            packet = proto.pack_request(
+                meta, payload, self.request_attachment,
+                checksum=self._channel.options.enable_checksum,
+            )
+            rc = sock.write(packet, id_wait=cid)
         if rc not in (0, errors.EFAILEDSOCKET):
             # overcrowded etc: surface through the error channel
             _cid.id_error(cid, rc)

@@ -40,6 +40,9 @@ class Protocol:
     name = "base"
     # protocols whose first bytes are a fixed magic can be probed cheaply
     magic: Optional[bytes] = None
+    # True: parse(buf, sock) receives the socket — connection-scoped
+    # protocols (h2/grpc) keep per-socket state (HPACK tables, windows)
+    stateful = False
     # True: process() runs inline on the parse loop (serial per socket).
     # Frame protocols that depend on arrival order need this — fanning out
     # to fiber tasks first would lose ordering before any downstream queue

@@ -163,6 +163,12 @@ class Server:
         from brpc_tpu.policy import ensure_registered
 
         ensure_registered()
+        if "Health" not in self._services:
+            # builtin grpc.health.v1.Health (reference server.cpp:499-601
+            # AddBuiltinServices / grpc_health_check_service)
+            from brpc_tpu.builtin.grpc_health import GrpcHealthService
+
+            self._services["Health"] = GrpcHealthService(self)
         ep = EndPoint.parse(address)
         fam, addr = ep.sockaddr()
         lsock = _socket.socket(fam, _socket.SOCK_STREAM)

@@ -14,24 +14,31 @@ from brpc_tpu.rpc.socket import Socket
 
 
 class SocketMap:
+    """Keyed by (EndPoint, signature): the reference's ChannelSignature —
+    channels with different connection-scoped configuration (e.g. protocol
+    family: an h2 connection can't carry trpc_std frames) get distinct
+    connections; same-signature channels share one."""
+
     def __init__(self, dispatcher, messenger):
         self._dispatcher = dispatcher
         self._messenger = messenger
-        self._map: Dict[EndPoint, Socket] = {}
+        self._map: Dict[tuple, Socket] = {}
         self._lock = threading.Lock()
-        # per-endpoint creation locks: a blocking connect to one dead host
+        # per-key creation locks: a blocking connect to one dead host
         # must not stall channels talking to healthy endpoints
-        self._create_locks: Dict[EndPoint, threading.Lock] = {}
+        self._create_locks: Dict[tuple, threading.Lock] = {}
 
-    def get_or_create(self, remote: EndPoint, connect_timeout: float = 3.0) -> Socket:
+    def get_or_create(self, remote: EndPoint, connect_timeout: float = 3.0,
+                      signature: str = "") -> Socket:
+        key = (remote, signature)
         with self._lock:
-            sock = self._map.get(remote)
+            sock = self._map.get(key)
             if sock is not None and not sock.failed:
                 return sock
-            create_lock = self._create_locks.setdefault(remote, threading.Lock())
-        with create_lock:  # serialize creation per endpoint only
+            create_lock = self._create_locks.setdefault(key, threading.Lock())
+        with create_lock:  # serialize creation per key only
             with self._lock:
-                sock = self._map.get(remote)
+                sock = self._map.get(key)
                 if sock is not None and not sock.failed:
                     return sock
             sock = Socket.connect(remote, self._dispatcher,
@@ -39,20 +46,21 @@ class SocketMap:
             sock._on_readable = self._messenger.make_on_readable(sock)
             sock.register_read()
             with self._lock:
-                self._map[remote] = sock
+                self._map[key] = sock
             return sock
 
-    def remove(self, remote: EndPoint) -> None:
+    def remove(self, remote: EndPoint, signature: str = "") -> None:
+        key = (remote, signature)
         with self._lock:
-            create_lock = self._create_locks.get(remote)
+            create_lock = self._create_locks.get(key)
         if create_lock is not None:
             # serialize against an in-flight get_or_create so a concurrent
             # connect can't re-insert a socket right after we pop it
             create_lock.acquire()
         try:
             with self._lock:
-                sock = self._map.pop(remote, None)
-                self._create_locks.pop(remote, None)  # no unbounded growth
+                sock = self._map.pop(key, None)
+                self._create_locks.pop(key, None)  # no unbounded growth
         finally:
             if create_lock is not None:
                 create_lock.release()
