@@ -24,11 +24,20 @@ def ensure_registered() -> None:
         from brpc_tpu.policy.http_protocol import HttpProtocol
         from brpc_tpu.policy.grpc_protocol import GrpcProtocol
 
+        from brpc_tpu.policy.redis_protocol import RedisProtocol
+        from brpc_tpu.policy.thrift_protocol import ThriftProtocol
+        from brpc_tpu.policy.memcache import MemcacheProtocol
+        from brpc_tpu.policy.nshead import NsheadProtocol
+
         register_protocol(TrpcStdProtocol())
         register_protocol(TrpcStreamProtocol())
         # grpc before http: the h2 preface ("PRI * HTTP/2.0...") would
         # otherwise parse as an HTTP/1 request-line
         register_protocol(GrpcProtocol())
+        register_protocol(RedisProtocol())
+        register_protocol(ThriftProtocol())
+        register_protocol(MemcacheProtocol())
+        register_protocol(NsheadProtocol())
         register_protocol(HttpProtocol())  # probed last: magic-less
         try:  # activate the C++ core (crc32c/fast_rand); fall back silently
             from brpc_tpu import native

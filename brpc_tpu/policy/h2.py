@@ -67,6 +67,8 @@ DEFAULT_MAX_FRAME = 16384
 RECV_STREAM_WINDOW = (1 << 31) - 1
 CONN_REPLENISH_AT = 1 << 28
 STREAM_REPLENISH_AT = 1 << 26
+# reassembled header block ceiling (CONTINUATION-flood guard)
+MAX_HEADER_BLOCK = 1 << 20
 
 
 class H2Error(Exception):
@@ -307,6 +309,8 @@ class H2Conn:
             if flags & FLAG_PADDED:
                 pad = payload[0]
                 payload = payload[1:len(payload) - pad]
+            if len(payload) > MAX_HEADER_BLOCK:
+                raise H2Error(PROTOCOL_ERROR, "header block too large")
             self._hdr_block = bytearray(payload)
             self._hdr_sid = sid
             self._hdr_flags = flags
@@ -316,6 +320,9 @@ class H2Conn:
             if self._hdr_block is None or sid != self._hdr_sid:
                 raise H2Error(PROTOCOL_ERROR, "unexpected CONTINUATION")
             self._hdr_block += payload
+            if len(self._hdr_block) > MAX_HEADER_BLOCK:
+                # unbounded reassembly is the h2 CONTINUATION-flood DoS
+                raise H2Error(PROTOCOL_ERROR, "header block too large")
             if flags & FLAG_END_HEADERS:
                 self._finish_header_block()
         elif ftype == SETTINGS:

@@ -355,6 +355,10 @@ class HpackEncoder:
 class HpackDecoder:
     def __init__(self, max_table_size: int = 4096):
         self.table = _DynamicTable(max_table_size)
+        # RFC 7541 §4.2: the peer may shrink/restore the table but never
+        # grow it past the size we advertised (our default: 4096) — an
+        # uncapped resize lets one connection grow memory without bound
+        self._advertised_max = max_table_size
 
     def _lookup(self, index: int) -> Tuple[str, str]:
         if index == 0:
@@ -381,6 +385,10 @@ class HpackDecoder:
                 headers.append((name, value))
             elif b & 0x20:  # dynamic table size update
                 new_size, pos = decode_int(data, pos, 5)
+                if new_size > self._advertised_max:
+                    raise HpackError(
+                        f"table size update {new_size} exceeds advertised "
+                        f"maximum {self._advertised_max}")
                 self.table.resize(new_size)
             else:  # literal without indexing (0x00) / never indexed (0x10)
                 index, pos = decode_int(data, pos, 4)

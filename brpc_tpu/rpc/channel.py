@@ -151,9 +151,11 @@ class Channel:
             from brpc_tpu.tpu.tpusocket import get_tpu_socket
 
             return get_tpu_socket(ep)
-        # connection-scoped protocols (h2/grpc) can't share a socket with
-        # frame protocols — key the shared map by protocol family
-        signature = "h2" if hasattr(self._protocol, "issue_request") else ""
+        # connection-scoped protocols (grpc/redis/thrift/...) can't share a
+        # socket with each other or with frame protocols — key the shared
+        # map by the protocol itself
+        signature = (self._protocol.name
+                     if hasattr(self._protocol, "issue_request") else "")
         return self._socket_map.get_or_create(
             ep, connect_timeout=self.options.connect_timeout_ms / 1000.0,
             signature=signature,

@@ -78,6 +78,30 @@ _protocols: List[Protocol] = []
 _by_name: Dict[str, Protocol] = {}
 _lock = threading.Lock()
 
+_state_init_lock = threading.Lock()
+
+
+def init_socket_state(sock, attr: str, factory, proto: "Protocol"):
+    """Create-once per-socket protocol state (client side): two first
+    callers racing must not both initialize (double preface / forked FIFO).
+    Sets the socket's preferred protocol as a side effect."""
+    state = getattr(sock, attr, None)
+    if state is None:
+        with _state_init_lock:
+            state = getattr(sock, attr, None)
+            if state is None:
+                state = factory()
+                setattr(sock, attr, state)
+                sock.preferred_protocol = proto
+    return state
+
+
+def dispatch_response(msg: "ParsedMessage") -> None:
+    """Shared client-completion trampoline for connection-scoped protocols."""
+    from brpc_tpu.rpc.controller import handle_response_message
+
+    handle_response_message(msg)
+
 
 def register_protocol(proto: Protocol) -> None:
     with _lock:

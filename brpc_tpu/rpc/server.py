@@ -119,6 +119,9 @@ class ServerOptions:
     auth: object = None               # Authenticator (policy/auth.py)
     idle_timeout_s: int = -1
     rpc_dump_dir: Optional[str] = None  # sample requests here (rpc_dump)
+    redis_service: object = None      # policy/redis_protocol.RedisService
+    thrift_service: object = None     # policy/thrift_protocol.ThriftService
+    nshead_service: object = None     # policy/nshead.NsheadService
 
 
 class Server:
