@@ -69,6 +69,6 @@ def ensure_builtin_registered() -> None:
     with _reg_lock:
         if _registered:
             return
-        from brpc_tpu.builtin import services  # noqa: F401  (registers all)
+        from brpc_tpu.builtin import profiling, services  # noqa: F401
 
         _registered = True

@@ -1,0 +1,236 @@
+"""Profiler builtin services — /hotspots/{cpu,heap,growth,contention},
+/pprof/{profile,heap,symbol,cmdline}, /vlog.
+
+Counterpart of the reference's ``builtin/hotspots_service.cpp`` (gperftools
+ProfilerStart / MallocExtension) and ``builtin/pprof_service.cpp`` (the
+pprof-tool-compatible endpoints). The runtime here is CPython, so the
+native profilers map to the interpreter's own: cProfile for CPU samples,
+tracemalloc for heap snapshots and growth, and the fiber runtime's
+contention counters for lock hotspots. Output is the pprof collapsed/text
+format (one "stack count" per line) that pprof and flamegraph.pl both read.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import logging
+import pstats
+import sys
+import threading
+import time
+import tracemalloc
+
+from brpc_tpu.builtin import register_builtin
+from brpc_tpu.policy.http_protocol import CONTENT_TEXT, HttpMessage
+
+_lock = threading.Lock()  # one profile run at a time (reference behavior)
+
+
+def _seconds(http: HttpMessage, default: float = 1.0) -> float:
+    try:
+        return min(float(http.query.get("seconds", default)), 60.0)
+    except (TypeError, ValueError):
+        return default
+
+
+# ------------------------------------------------------------------ cpu
+def _run_cpu_profile(seconds: float) -> pstats.Stats:
+    prof = cProfile.Profile()
+    prof.enable()
+    time.sleep(seconds)  # sample everything the interpreter runs meanwhile
+    prof.disable()
+    return pstats.Stats(prof)
+
+
+def _stats_text(stats: pstats.Stats, sort: str = "cumulative",
+                limit: int = 60) -> str:
+    out = io.StringIO()
+    stats.stream = out
+    stats.sort_stats(sort).print_stats(limit)
+    return out.getvalue()
+
+
+def cpu_service(server, http: HttpMessage):
+    """/hotspots/cpu?seconds=N — profile the whole process for N seconds."""
+    if not _lock.acquire(blocking=False):
+        return 503, CONTENT_TEXT, "another profile is running\n"
+    try:
+        seconds = _seconds(http)
+        stats = _run_cpu_profile(seconds)
+        return 200, CONTENT_TEXT, (
+            f"# cpu profile over {seconds:.1f}s (cProfile; whole process)\n"
+            + _stats_text(stats))
+    finally:
+        _lock.release()
+
+
+# ------------------------------------------------------------------ heap
+_heap_baseline = None
+
+
+def heap_service(server, http: HttpMessage):
+    """/hotspots/heap — top allocation sites right now (tracemalloc)."""
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(16)
+        return (200, CONTENT_TEXT,
+                "heap tracing just started — request again for a snapshot\n")
+    snap = tracemalloc.take_snapshot()
+    lines = ["# heap snapshot: top allocation sites (tracemalloc)"]
+    for stat in snap.statistics("lineno")[:60]:
+        lines.append(f"{stat.size:>12d} B {stat.count:>8d} blocks  "
+                     f"{stat.traceback}")
+    total = sum(s.size for s in snap.statistics("filename"))
+    lines.append(f"# total traced: {total} bytes")
+    return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
+
+
+def growth_service(server, http: HttpMessage):
+    """/hotspots/growth — allocation growth since the previous call
+    (the reference's MallocExtension growth stacks)."""
+    global _heap_baseline
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(16)
+    snap = tracemalloc.take_snapshot()
+    if _heap_baseline is None:
+        _heap_baseline = snap
+        return (200, CONTENT_TEXT,
+                "growth baseline captured — request again to diff\n")
+    diffs = snap.compare_to(_heap_baseline, "lineno")
+    _heap_baseline = snap
+    lines = ["# heap growth since previous /hotspots/growth"]
+    for d in diffs[:60]:
+        if d.size_diff == 0:
+            continue
+        lines.append(f"{d.size_diff:>+12d} B {d.count_diff:>+8d} blocks  "
+                     f"{d.traceback}")
+    return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------------- contention
+def contention_service(server, http: HttpMessage):
+    """/hotspots/contention — fiber/lock wait hotspots."""
+    from brpc_tpu.fiber import runtime
+
+    lines = ["# contention (fiber runtime)"]
+    stats = getattr(runtime, "contention_stats", None)
+    if callable(stats):
+        for site, waits, wait_ns in stats():
+            lines.append(f"{wait_ns / 1e6:>12.2f} ms {waits:>8d} waits  {site}")
+    else:
+        # fall back to a thread-stack sample: threads inside lock.acquire
+        frames = sys._current_frames()
+        for tid, frame in frames.items():
+            import traceback as _tb
+
+            stack = _tb.extract_stack(frame)
+            if any("acquire" in (f.name or "") or "wait" in (f.name or "")
+                   for f in stack[-3:]):
+                lines.append(f"thread {tid} blocked at "
+                             f"{stack[-1].filename}:{stack[-1].lineno} "
+                             f"({stack[-1].name})")
+    if len(lines) == 1:
+        lines.append("(no contention observed)")
+    return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------- pprof
+def pprof_profile_service(server, http: HttpMessage):
+    """/pprof/profile?seconds=N — collapsed-stack format (flamegraph/pprof
+    both ingest it)."""
+    if not _lock.acquire(blocking=False):
+        return 503, CONTENT_TEXT, "another profile is running\n"
+    try:
+        seconds = _seconds(http)
+        stats = _run_cpu_profile(seconds)
+        lines = []
+        for (filename, lineno, name), (cc, nc, tt, ct, callers) in \
+                stats.stats.items():
+            frame = f"{filename.rsplit('/', 1)[-1]}:{lineno}:{name}"
+            # weight = time in microseconds so small profiles don't all
+            # collapse to zero
+            weight = max(int(tt * 1e6), 0)
+            if weight and not callers:
+                lines.append(f"{frame} {weight}")
+            for (cfile, cline, cname), (ccc, cnc, ctt, cct) in callers.items():
+                cframe = f"{cfile.rsplit('/', 1)[-1]}:{cline}:{cname}"
+                w = max(int(cct * 1e6), 1)
+                lines.append(f"{cframe};{frame} {w}")
+        return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
+    finally:
+        _lock.release()
+
+
+def pprof_heap_service(server, http: HttpMessage):
+    return heap_service(server, http)
+
+
+def pprof_symbol_service(server, http: HttpMessage):
+    """pprof probes this to decide symbolization; Python stacks are already
+    symbolized."""
+    return 200, CONTENT_TEXT, "num_symbols: 1\n"
+
+
+def pprof_cmdline_service(server, http: HttpMessage):
+    return 200, CONTENT_TEXT, "\x00".join(sys.argv) + "\n"
+
+
+# ------------------------------------------------------------------ vlog
+def vlog_service(server, http: HttpMessage):
+    """/vlog — list logger levels; /vlog?logger=name&level=DEBUG sets one
+    (the reference's VLOG site toggling)."""
+    q = http.query
+    if q.get("logger") is not None:
+        name = q.get("logger") or None
+        level = (q.get("level") or "INFO").upper()
+        if level not in ("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"):
+            return 400, CONTENT_TEXT, f"bad level {level!r}\n"
+        logging.getLogger(name).setLevel(level)
+        return 200, CONTENT_TEXT, f"{name or 'root'} -> {level}\n"
+    lines = ["# loggers (set with /vlog?logger=<name>&level=<LEVEL>)"]
+    all_loggers = [logging.getLogger()] + [
+        logging.getLogger(n)
+        for n in sorted(logging.root.manager.loggerDict)
+    ]
+    for lg in all_loggers:
+        if isinstance(lg, logging.PlaceHolder):
+            continue
+        eff = logging.getLevelName(lg.getEffectiveLevel())
+        own = (logging.getLevelName(lg.level) if lg.level else "-")
+        lines.append(f"{lg.name or 'root':<50} level={own:<8} eff={eff}")
+    return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
+
+
+def _sub(http: HttpMessage) -> str:
+    parts = http.path.strip("/").split("/", 1)
+    return parts[1] if len(parts) > 1 else ""
+
+
+_HOTSPOTS = {"cpu": cpu_service, "heap": heap_service,
+             "growth": growth_service, "contention": contention_service}
+_PPROF = {"profile": pprof_profile_service, "heap": pprof_heap_service,
+          "symbol": pprof_symbol_service, "cmdline": pprof_cmdline_service}
+
+
+def hotspots_service(server, http: HttpMessage):
+    sub = _sub(http)
+    handler = _HOTSPOTS.get(sub)
+    if handler is None:
+        return 200, CONTENT_TEXT, (
+            "profilers: " + " ".join(f"/hotspots/{k}" for k in _HOTSPOTS)
+            + "\n")
+    return handler(server, http)
+
+
+def pprof_service(server, http: HttpMessage):
+    handler = _PPROF.get(_sub(http))
+    if handler is None:
+        return 404, CONTENT_TEXT, (
+            "endpoints: " + " ".join(f"/pprof/{k}" for k in _PPROF) + "\n")
+    return handler(server, http)
+
+
+register_builtin("hotspots", hotspots_service,
+                 "cpu/heap/growth/contention profilers")
+register_builtin("pprof", pprof_service, "pprof-compatible endpoints")
+register_builtin("vlog", vlog_service, "list/set logger levels")

@@ -11,15 +11,42 @@ callback (SURVEY §5.8: butex signaled from PJRT callback).
 from __future__ import annotations
 
 import threading
-from typing import Optional
+import time
+from typing import Dict, List, Optional, Tuple
+
+# contention bookkeeping (reference bthread/mutex.cpp:63-80 contention
+# profiler): per-site wait counts + total wait time, sampled cheaply —
+# only waits that actually blocked are recorded
+_contention: Dict[str, List[int]] = {}
+_contention_lock = threading.Lock()
+
+
+def record_contention(site: str, wait_ns: int) -> None:
+    with _contention_lock:
+        ent = _contention.get(site)
+        if ent is None:
+            if len(_contention) >= 1024:  # bounded table
+                return
+            _contention[site] = [1, wait_ns]
+        else:
+            ent[0] += 1
+            ent[1] += wait_ns
+
+
+def contention_stats() -> List[Tuple[str, int, int]]:
+    """[(site, waits, total_wait_ns)] sorted by wait time desc."""
+    with _contention_lock:
+        rows = [(site, ent[0], ent[1]) for site, ent in _contention.items()]
+    return sorted(rows, key=lambda r: -r[2])
 
 
 class Butex:
-    __slots__ = ("_value", "_cond")
+    __slots__ = ("_value", "_cond", "_site")
 
-    def __init__(self, value: int = 0):
+    def __init__(self, value: int = 0, site: str = ""):
         self._value = value
         self._cond = threading.Condition()
+        self._site = site
 
     @property
     def value(self) -> int:
@@ -38,9 +65,13 @@ class Butex:
         with self._cond:
             if self._value != expected:
                 return True
-            return self._cond.wait_for(
+            t0 = time.monotonic_ns()
+            woken = self._cond.wait_for(
                 lambda: self._value != expected, timeout=timeout
             )
+            if self._site:
+                record_contention(self._site, time.monotonic_ns() - t0)
+            return woken
 
     def wake(self, value: Optional[int] = None, n: Optional[int] = None) -> None:
         """Optionally store a new value, then wake sleepers (all by default)."""

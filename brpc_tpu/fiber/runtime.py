@@ -20,6 +20,7 @@ import threading
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from brpc_tpu.fiber.butex import contention_stats  # noqa: F401  (re-export for /hotspots/contention)
 from brpc_tpu.metrics.reducer import Adder
 
 DEFAULT_TAG = 0

@@ -280,7 +280,10 @@ class PartitionChannel(ParallelChannel):
     def init(self, ns_url: str, partition_count: int,
              parser: Optional[PartitionParser] = None,
              lb_name: str = "rr",
-             options: Optional[ChannelOptions] = None) -> "PartitionChannel":
+             options: Optional[ChannelOptions] = None,
+             call_mapper: Optional[CallMapper] = None,
+             response_merger: Optional[ResponseMerger] = None,
+             ) -> "PartitionChannel":
         from brpc_tpu.policy.load_balancers import create_load_balancer
         from brpc_tpu.policy.naming import start_naming_service
 
@@ -308,5 +311,6 @@ class PartitionChannel(ParallelChannel):
             sub = Channel(options or ChannelOptions())
             sub._protocol = None  # init below
             sub.init_with_lb(lb)
-            self.add_channel(sub)
+            self.add_channel(sub, call_mapper=call_mapper,
+                             response_merger=response_merger)
         return self

@@ -65,7 +65,7 @@ class Stream:
         # --- writer-side credit accounting
         self._produced = 0
         self._remote_consumed = 0
-        self._write_butex = Butex(0)
+        self._write_butex = Butex(0, site="stream.write_window")
         self._seq = 0
         self._write_lock = threading.Lock()
         # --- receiver side

@@ -1,0 +1,54 @@
+"""Echo server (reference example/echo_c++/server.cpp).
+
+    python examples/echo/server.py [--port 8000]
+
+While it runs, the same port serves the builtin dashboard:
+    curl localhost:8000/status   curl localhost:8000/vars
+"""
+
+import argparse
+import sys
+import time
+
+from brpc_tpu.proto import echo_pb2
+from brpc_tpu.rpc import Server, ServerOptions, Service
+
+
+class EchoServiceImpl(Service):
+    DESCRIPTOR = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+    def Echo(self, cntl, request, done):
+        # attachments round-trip untouched by serialization, like the
+        # reference example demonstrates
+        cntl.response_attachment = cntl.request_attachment
+        return echo_pb2.EchoResponse(message=request.message,
+                                     payload=request.payload)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--idle_timeout_s", type=int, default=-1)
+    ap.add_argument("--run_seconds", type=float, default=0,
+                    help="exit after N seconds (0 = forever)")
+    args = ap.parse_args(argv)
+
+    server = Server(ServerOptions(idle_timeout_s=args.idle_timeout_s))
+    server.add_service(EchoServiceImpl())
+    server.start(f"0.0.0.0:{args.port}")
+    print(f"EchoServer listening on {server.listen_endpoint()}", flush=True)
+    try:
+        if args.run_seconds:
+            time.sleep(args.run_seconds)
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+    server.join()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,106 @@
+"""Smoke-run every example (the reference treats examples as living docs +
+perf harnesses; ours must stay runnable). Single-file examples run in-proc
+via their main(); server+client pairs run as subprocesses on random ports."""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {**os.environ, "PYTHONPATH": REPO}
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_pair(server_rel, client_rel, client_args, port, timeout=40):
+    server = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, server_rel),
+         "--port", str(port), "--run_seconds", "30"],
+        env=ENV, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline:  # wait for the listen line
+            line = server.stdout.readline()
+            if "listening" in line.lower() or "server on" in line.lower():
+                break
+        else:
+            pytest.fail("server never came up")
+        client = subprocess.run(
+            [sys.executable, os.path.join(REPO, client_rel), *client_args],
+            env=ENV, capture_output=True, text=True, timeout=timeout)
+        assert client.returncode == 0, client.stdout + client.stderr
+        return client.stdout
+    finally:
+        server.kill()
+        server.wait()
+
+
+def run_single(rel, args=(), timeout=60):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, rel), *args],
+        env=ENV, capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return proc.stdout
+
+
+class TestExamplePairs:
+    def test_echo(self):
+        port = free_port()
+        out = run_pair("examples/echo/server.py", "examples/echo/client.py",
+                       ["--server", f"127.0.0.1:{port}", "-n", "3"], port)
+        assert "hello 2" in out and "attachment" in out
+
+    def test_streaming_echo(self):
+        port = free_port()
+        out = run_pair("examples/streaming_echo/server.py",
+                       "examples/streaming_echo/client.py",
+                       ["--server", f"127.0.0.1:{port}", "-n", "30"], port)
+        assert "echoed 30 messages" in out
+
+    def test_grpc_echo(self):
+        port = free_port()
+        out = run_pair("examples/grpc_echo/server.py",
+                       "examples/grpc_echo/client.py",
+                       ["--server", f"127.0.0.1:{port}", "-n", "3"], port)
+        assert "grpc 2" in out and "SERVING" in out
+
+    def test_multi_threaded_echo(self):
+        port = free_port()
+        out = run_pair("examples/echo/server.py",
+                       "examples/multi_threaded_echo/client.py",
+                       ["--server", f"127.0.0.1:{port}",
+                        "--threads", "4", "--seconds", "2"], port)
+        assert "qps=" in out and "final:" in out
+
+
+class TestSingleFileExamples:
+    def test_parallel_echo(self):
+        out = run_single("examples/parallel_echo/client.py", ["-n", "2"])
+        assert "[srv0]" in out and "[srv1]" in out and "[srv2]" in out
+
+    def test_selective_echo(self):
+        out = run_single("examples/selective_echo/client.py", ["-n", "6"])
+        assert "killed srv0" in out
+
+    def test_partition_echo(self):
+        out = run_single("examples/partition_echo/client.py", ["-n", "2"])
+        assert "p0" in out and "p2" in out
+
+    def test_backup_request(self):
+        out = run_single("examples/backup_request/client.py", ["-n", "4"])
+        assert "backup=yes" in out and "fast" in out
+
+    def test_tpu_transfer(self):
+        out = run_single("examples/tpu_transfer/client.py",
+                         ["--sizes", "4096,65536", "-n", "4"])
+        assert "MB/s" in out

@@ -1,0 +1,97 @@
+"""Profiler builtin tests — /hotspots/*, /pprof/*, /vlog (reference
+builtin/hotspots_service + pprof_service + vlog_service)."""
+
+import logging
+
+import pytest
+
+from brpc_tpu.policy.http_protocol import http_fetch
+from brpc_tpu.proto import echo_pb2
+from brpc_tpu.rpc import Channel, ChannelOptions, Server, Service, Stub
+
+
+class Echo(Service):
+    DESCRIPTOR = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+    def Echo(self, cntl, request, done):
+        return echo_pb2.EchoResponse(message=request.message)
+
+
+@pytest.fixture()
+def server():
+    srv = Server().add_service(Echo()).start("127.0.0.1:0")
+    yield srv
+    srv.stop()
+    srv.join(timeout=2)
+
+
+class TestProfiling:
+    def test_cpu_profile(self, server):
+        ep = str(server.listen_endpoint())
+        r = http_fetch(ep, path="/hotspots/cpu?seconds=0.2", timeout=10)
+        assert r.status == 200
+        assert b"cumulative" in r.body
+
+    def test_heap_snapshot_and_growth(self, server):
+        ep = str(server.listen_endpoint())
+        http_fetch(ep, path="/hotspots/heap")  # may just start tracing
+        r = http_fetch(ep, path="/hotspots/heap")
+        assert r.status == 200 and b"allocation sites" in r.body
+        http_fetch(ep, path="/hotspots/growth")
+        # allocate between the two growth snapshots
+        blob = [bytearray(1024) for _ in range(100)]
+        r = http_fetch(ep, path="/hotspots/growth")
+        assert r.status == 200 and b"growth since" in r.body
+        del blob
+
+    def test_contention_endpoint(self, server):
+        ep = str(server.listen_endpoint())
+        r = http_fetch(ep, path="/hotspots/contention")
+        assert r.status == 200 and b"contention" in r.body
+
+    def test_hotspots_index(self, server):
+        ep = str(server.listen_endpoint())
+        r = http_fetch(ep, path="/hotspots")
+        assert b"/hotspots/cpu" in r.body
+
+    def test_pprof_endpoints(self, server):
+        ep = str(server.listen_endpoint())
+        stub = Stub(Channel(ChannelOptions()).init(ep), Echo.DESCRIPTOR)
+        for _ in range(10):
+            stub.Echo(echo_pb2.EchoRequest(message="load"))
+        r = http_fetch(ep, path="/pprof/profile?seconds=0.2", timeout=10)
+        assert r.status == 200
+        assert b";" in r.body or b" " in r.body  # collapsed stacks
+        assert b"num_symbols" in http_fetch(ep, path="/pprof/symbol").body
+        assert http_fetch(ep, path="/pprof/cmdline").status == 200
+        assert http_fetch(ep, path="/pprof/nope").status == 404
+
+    def test_vlog_list_and_set(self, server):
+        ep = str(server.listen_endpoint())
+        r = http_fetch(ep, path="/vlog")
+        assert r.status == 200 and b"loggers" in r.body
+        r = http_fetch(ep, path="/vlog?logger=brpc_tpu.test&level=DEBUG")
+        assert b"DEBUG" in r.body
+        assert logging.getLogger("brpc_tpu.test").level == logging.DEBUG
+        r = http_fetch(ep, path="/vlog?logger=brpc_tpu.test&level=BOGUS")
+        assert r.status == 400
+
+    def test_contention_records_real_waits(self, server):
+        from brpc_tpu.fiber.butex import Butex, contention_stats
+        import threading
+        import time
+
+        bx = Butex(0, site="test.site")
+
+        def waiter():
+            bx.wait(0, timeout=2)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        bx.wake(1)
+        t.join()
+        rows = {site: (w, ns) for site, w, ns in contention_stats()}
+        assert "test.site" in rows
+        waits, wait_ns = rows["test.site"]
+        assert waits >= 1 and wait_ns > 0
