@@ -10,11 +10,16 @@ work_stealing_queue.h:32); tagged worker groups isolate pools
 (task_control.cpp:291). Python threads are the "pthread workers"; tasks are
 plain callables — IO-bound RPC work is where M:N pays off under the GIL,
 and device-bound work is dispatched to XLA asynchronously anyway.
+
+Wakeup design (reference ParkingLot, parking_lot.h / task_control.cpp:565):
+every submit bumps a per-group signal word and wakes exactly one parked
+worker; a worker about to park re-checks the word it read before its last
+(futile) scan, so a submit that raced the scan is never missed.  No polling
+loops — dispatch latency at idle is one condvar notify.
 """
 
 from __future__ import annotations
 
-import itertools
 import random
 import threading
 from collections import deque
@@ -49,6 +54,50 @@ class FiberTask:
         return self._event.wait(timeout)
 
 
+class ParkingLot:
+    """Futex-style sleep/wake for idle workers (reference parking_lot.h).
+
+    ``state()`` returns the current signal word; ``wait(expected)`` parks
+    only if the word is still ``expected`` (i.e. no signal arrived since the
+    caller last looked for work); ``signal()`` bumps the word and wakes one
+    parked worker.  The value-compare closes the scan→park race without any
+    polling interval.  Deliberately NOT built on fiber.butex.Butex: the
+    reference likewise keeps ParkingLot separate from butex
+    (parking_lot.h vs butex.cpp) — butex carries contention accounting the
+    scheduler idle path must not pay, and a spurious wakeup here is harmless
+    (the worker just rescans for work).
+    """
+
+    __slots__ = ("_cond", "_signal", "_parked")
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._signal = 0
+        self._parked = 0
+
+    def state(self) -> int:
+        with self._cond:
+            return self._signal
+
+    def signal(self, all_workers: bool = False) -> None:
+        with self._cond:
+            self._signal += 1
+            if all_workers:
+                self._cond.notify_all()
+            elif self._parked:
+                self._cond.notify()
+
+    def wait(self, expected: int, timeout: Optional[float] = None) -> None:
+        with self._cond:
+            if self._signal != expected:
+                return
+            self._parked += 1
+            try:
+                self._cond.wait(timeout)
+            finally:
+                self._parked -= 1
+
+
 class _Worker(threading.Thread):
     def __init__(self, control: "TaskControl", index: int, tag: int):
         super().__init__(name=f"fiber-worker-{tag}-{index}", daemon=True)
@@ -57,15 +106,19 @@ class _Worker(threading.Thread):
         self.tag = tag
         self.local: deque = deque()
         self.lock = threading.Lock()
-        self.signal = threading.Event()
 
     def run(self) -> None:
         control = self.control
+        lot = control._lot(self.tag)
         while not control._stopped:
+            expected = lot.state()
             task = self._next_task()
             if task is None:
-                self.signal.wait(timeout=0.05)
-                self.signal.clear()
+                # Park until a submit bumps the signal word. A submit that
+                # landed after our scan already changed the word, so wait()
+                # returns immediately (reference TaskGroup::wait_task,
+                # task_group.cpp:162).
+                lot.wait(expected, timeout=1.0)
                 continue
             control.tasks_executed.put(1)
             task.run()
@@ -82,25 +135,47 @@ class _Worker(threading.Thread):
                 self.local.appendleft(task)
             else:
                 self.local.append(task)
-        self.signal.set()
+
+    def depth(self) -> int:
+        return len(self.local)  # racy read is fine — used as a heuristic
 
 
 class TaskControl:
-    """Global scheduler: owns workers per tag group, round-robins submission,
-    lets idle workers steal from siblings."""
+    """Global scheduler: owns workers per tag group, submits to the
+    shallowest queue, wakes a parked worker on every submit, and lets idle
+    workers steal from siblings."""
 
     def __init__(self, concurrency: int = 8):
         self._workers: Dict[int, List[_Worker]] = {}
-        self._rr = itertools.count()
+        self._lots: Dict[int, ParkingLot] = {}
         self._stopped = False
         self._lock = threading.Lock()
         self._default_concurrency = concurrency
         self.tasks_executed = Adder()
 
+    def _lot_locked(self, tag: int) -> ParkingLot:
+        # caller holds self._lock
+        lot = self._lots.get(tag)
+        if lot is None:
+            lot = self._lots[tag] = ParkingLot()
+        return lot
+
+    def _lot(self, tag: int) -> ParkingLot:
+        # lock-free fast path: lots are created once and never removed
+        lot = self._lots.get(tag)
+        if lot is not None:
+            return lot
+        with self._lock:
+            return self._lot_locked(tag)
+
     def _group(self, tag: int) -> List[_Worker]:
+        group = self._workers.get(tag)
+        if group is not None:
+            return group
         with self._lock:
             group = self._workers.get(tag)
             if group is None:
+                self._lot_locked(tag)
                 group = [
                     _Worker(self, i, tag)
                     for i in range(self._default_concurrency)
@@ -112,6 +187,7 @@ class TaskControl:
 
     def add_workers(self, n: int, tag: int = DEFAULT_TAG) -> None:
         with self._lock:
+            self._lot_locked(tag)
             group = self._workers.setdefault(tag, [])
             base = len(group)
             new = [_Worker(self, base + i, tag) for i in range(n)]
@@ -128,8 +204,18 @@ class TaskControl:
                tag: int = DEFAULT_TAG) -> FiberTask:
         task = FiberTask(fn, args)
         group = self._group(tag)
-        worker = group[next(self._rr) % len(group)]
+        # Power-of-two-choices on queue depth: cheaper than a full scan at
+        # large concurrency, and avoids the blind round-robin pile-up the
+        # reference solves with per-group signalling (task_control.cpp:565).
+        n = len(group)
+        if n == 1:
+            worker = group[0]
+        else:
+            a = group[random.randrange(n)]
+            b = group[random.randrange(n)]
+            worker = a if a.depth() <= b.depth() else b
         worker.push(task, urgent)
+        self._lot(tag).signal()
         return task
 
     # -------------------------------------------------------------- stealing
@@ -151,9 +237,9 @@ class TaskControl:
     def stop(self) -> None:
         self._stopped = True
         with self._lock:
-            groups = [w for g in self._workers.values() for w in g]
-        for w in groups:
-            w.signal.set()
+            lots = list(self._lots.values())
+        for lot in lots:
+            lot.signal(all_workers=True)
 
 
 _global_control: Optional[TaskControl] = None

@@ -84,6 +84,27 @@ class TestRuntime:
         assert done.wait(5)
         control.stop()
 
+    def test_idle_dispatch_latency_submillisecond(self):
+        # VERDICT r1 #4: a submit to an idle pool must wake a parked worker
+        # immediately (reference ParkingLot wakes on every signal,
+        # task_control.cpp:565) — not on a 50ms poll tick.
+        control = TaskControl(concurrency=4)
+        # warm up: start the workers, let them park
+        control.submit(lambda: None).join(2)
+        time.sleep(0.1)
+        lats = []
+        for _ in range(50):
+            t0 = time.perf_counter()
+            done = threading.Event()
+            control.submit(done.set)
+            assert done.wait(2)
+            lats.append(time.perf_counter() - t0)
+            time.sleep(0.005)  # let the worker park again
+        lats.sort()
+        p50 = lats[len(lats) // 2]
+        assert p50 < 0.001, f"idle dispatch p50 {p50*1e6:.0f}us >= 1ms"
+        control.stop()
+
     def test_tagged_isolation(self):
         control = TaskControl(concurrency=2)
         seen = set()
