@@ -29,8 +29,13 @@ def ensure_registered() -> None:
         from brpc_tpu.policy.memcache import MemcacheProtocol
         from brpc_tpu.policy.nshead import NsheadProtocol
 
+        from brpc_tpu.tpu.transport import TpuCtrlProtocol
+
         register_protocol(TrpcStdProtocol())
         register_protocol(TrpcStreamProtocol())
+        # early: TPUC magic must never reach text-probing protocols (redis
+        # inline commands would happily eat it)
+        register_protocol(TpuCtrlProtocol())
         # grpc before http: the h2 preface ("PRI * HTTP/2.0...") would
         # otherwise parse as an HTTP/1 request-line
         register_protocol(GrpcProtocol())

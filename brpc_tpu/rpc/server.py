@@ -140,6 +140,8 @@ class Server:
         self._concurrency_lock = threading.Lock()
         self.requests_processed = Adder()
         self._idle_sweep_timer = None
+        self._tpu_ordinal = -1          # device this server fronts (tpu://)
+        self._tpu_endpoints: Set[object] = set()
         self.rpc_dumper = None
         if self.options.rpc_dump_dir:
             from brpc_tpu.trace.rpc_dump import RpcDumper
@@ -173,7 +175,15 @@ class Server:
 
             self._services["Health"] = GrpcHealthService(self)
         ep = EndPoint.parse(address)
-        fam, addr = ep.sockaddr()
+        if ep.is_tpu():
+            # tpu://host:port/ordinal — the TCP port is the tunnel bootstrap
+            # (the RDMA handshake listener); accepted connections upgrade to
+            # TpuEndpoints when the TPUC HELLO arrives (tpu/transport.py)
+            self._tpu_ordinal = ep.device_ordinal
+            fam, addr = EndPoint.from_ip_port(ep.host or "0.0.0.0",
+                                              ep.port).sockaddr()
+        else:
+            fam, addr = ep.sockaddr()
         lsock = _socket.socket(fam, _socket.SOCK_STREAM)
         lsock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
         lsock.bind(addr)
@@ -181,7 +191,11 @@ class Server:
         lsock.setblocking(False)
         self._listen_sock = lsock
         host, port = lsock.getsockname()[:2]
-        self._listen_ep = EndPoint.from_ip_port(host, port)
+        if ep.is_tpu():
+            self._listen_ep = EndPoint.from_tpu(host, ep.device_ordinal,
+                                                port=port)
+        else:
+            self._listen_ep = EndPoint.from_ip_port(host, port)
         self._running = True
         self._logoff = False
         self._dispatcher.add_consumer(
@@ -220,6 +234,10 @@ class Server:
             time.sleep(0.01)
         with self._conn_lock:
             conns = list(self._connections)
+            eps = list(self._tpu_endpoints)
+            self._tpu_endpoints.clear()
+        for e in eps:
+            e.close()   # BYE + pool teardown; also fails the bootstrap conn
         for c in conns:
             c.close()
         self._running = False
@@ -282,6 +300,13 @@ class Server:
     def _on_connection_closed(self, sock: Socket) -> None:
         with self._conn_lock:
             self._connections.discard(sock)
+            ep = getattr(sock, "_tpu_endpoint", None)
+            if ep is not None:
+                self._tpu_endpoints.discard(ep)
+
+    def _register_tpu_endpoint(self, ep) -> None:
+        with self._conn_lock:
+            self._tpu_endpoints.add(ep)
 
     def connection_count(self) -> int:
         with self._conn_lock:

@@ -69,6 +69,9 @@ class Socket:
         self.socket_id = _socket_pool.insert(self)
         self._on_readable = on_readable
         self._close_lock = threading.Lock()
+        # invoked once from set_failed — transports layered on this socket
+        # (tpu tunnel endpoints) tear down with it
+        self.on_failed_hook = None
 
     # --------------------------------------------------------------- factory
     @staticmethod
@@ -227,6 +230,12 @@ class Socket:
             self._pending_ids.clear()
         for cid in pending:
             _cid.id_error(cid, code)
+        hook = self.on_failed_hook
+        if hook is not None:
+            try:
+                hook(code, reason)
+            except Exception:
+                pass
         if self.owner_server is not None:
             self.owner_server._on_connection_closed(self)
 

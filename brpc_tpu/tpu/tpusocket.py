@@ -243,8 +243,18 @@ _sockets: Dict[Tuple[str, int], TpuSocket] = {}
 _sockets_lock = threading.Lock()
 
 
-def get_tpu_socket(ep: EndPoint) -> TpuSocket:
-    """Shared per-device socket (the SocketMap of the device world)."""
+def get_tpu_socket(ep: EndPoint):
+    """Shared per-device socket (the SocketMap of the device world).
+
+    Routing: ``tpu://host:port/ordinal`` (port set) is a REMOTE device — a
+    peer process serving that chip; dial the cross-process tunnel
+    (tpu/transport.py). ``tpu://host/ordinal`` (no port) is a local chip of
+    this process; calls run as device programs in-process (the loopback
+    fast path, like the reference short-circuiting 127.0.0.1)."""
+    if ep.port:
+        from brpc_tpu.tpu.transport import connect_tpu
+
+        return connect_tpu(ep)
     key = (ep.host, ep.device_ordinal)
     with _sockets_lock:
         sock = _sockets.get(key)

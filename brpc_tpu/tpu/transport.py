@@ -1,0 +1,662 @@
+"""Cross-process tpu:// transport — the graft's RDMA-endpoint analog.
+
+Two processes, each owning its accelerator devices, exchange RPC traffic
+through (a) a TCP *bootstrap/control* connection and (b) *registered block
+pools* — shared-memory staging areas playing the role of the RDMA
+registered memory region / PJRT pinned-host buffers. The design follows the
+reference RdmaEndpoint blueprint point for point (SURVEY §3.5/§5.8):
+
+  reference (rdma_endpoint.cpp)          this module
+  -------------------------------------  -----------------------------------
+  TCP handshake exchanging GID/QPN       HELLO/HELLO_ACK frames exchanging
+    (:127-130)                             device ordinal + pool name/geometry
+  registered block pool (block_pool.cpp) BlockPool: shm segment cut into
+                                           fixed-size pinned-host blocks
+  post_send of IOBuf blocks              sender memcpys into *peer* pool
+                                           blocks, posts a DATA frame
+  explicit-ACK sliding window            ACK frames return block credits;
+    (rdma_endpoint.h:256-261)              senders park on the credit window
+  CQ events -> EventDispatcher           control frames ride the normal
+    (rdma_endpoint.h:201)                  Socket/EventDispatcher loop
+  same InputMessenger parsing as TCP     reassembled bytes feed the virtual
+    (input_messenger.cpp:416)              socket's read_buf -> cut_messages
+
+The tunnel is a byte stream: DATA frames carry ordered chunks of it, so an
+RPC packet larger than the window streams through a bounded number of
+blocks (credit flow control), and ANY registered protocol — trpc_std, h2,
+redis — rides the tpu transport unchanged, because delivery goes through
+the very same InputMessenger cut loop as TCP bytes. The "virtual socket"
+trick is the reference's own (a brpc Stream IS a fake Socket, stream.cpp).
+
+Cross-host (DCN) fallback: when the peer's shm pool cannot be attached
+(different host), the endpoint degrades to inline DATA frames over the
+control connection — same framing, no shm, window = TCP backpressure.
+
+On real multi-host TPU hardware the BlockPool maps onto PJRT pinned-host
+allocations and the DATA/ACK doorbells onto ICI transfers; the handshake,
+window accounting, and virtual-socket delivery are transport-independent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import struct
+import threading
+import time as _time
+from collections import deque
+from multiprocessing import shared_memory as _shm
+from typing import Dict, List, Optional, Tuple
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.butil.resource_pool import VersionedPool
+from brpc_tpu.fiber import call_id as _cid
+from brpc_tpu.metrics.reducer import Adder
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.protocol import (
+    PARSE_BAD,
+    PARSE_NOT_ENOUGH_DATA,
+    PARSE_TRY_OTHERS,
+    ParsedMessage,
+    Protocol,
+)
+
+CTRL_MAGIC = b"TPUC"
+CTRL_HDR = "!4sBI"            # magic, frame type, body length
+CTRL_HDR_SIZE = struct.calcsize(CTRL_HDR)
+
+FT_HELLO = 1      # client -> server: my pool + target device
+FT_HELLO_ACK = 2  # server -> client: my pool + my device
+FT_DATA = 3       # ordered chunk of the tunnel byte stream
+FT_ACK = 4        # return block credits
+FT_BYE = 5        # orderly shutdown
+
+DATA_BODY_HDR = "!II"         # inline_len, nsegs
+SEG_FMT = "!II"               # block index, length
+_SEG_SIZE = struct.calcsize(SEG_FMT)
+
+DEFAULT_BLOCK_SIZE = 256 * 1024
+DEFAULT_BLOCK_COUNT = 64      # 16 MB window per direction
+INLINE_MAX = 16 * 1024        # small messages skip the block pool entirely
+MAX_SEGS_PER_FRAME = 32
+HANDSHAKE_VERSION = 1
+
+# device-fabric traffic counters (the /vars view of the "ICI NIC")
+g_tunnel_in_bytes = Adder()
+g_tunnel_out_bytes = Adder()
+
+
+# names created by THIS process (owner keeps resource_tracker registration)
+_owned_pools = set()
+
+
+def _cleanup_owned_pools() -> None:
+    for name in list(_owned_pools):
+        try:
+            seg = _shm.SharedMemory(name=name)
+            seg.close()
+            seg.unlink()
+        except Exception:
+            pass
+        _owned_pools.discard(name)
+
+
+import atexit as _atexit  # noqa: E402
+
+_atexit.register(_cleanup_owned_pools)
+
+
+def _maybe_untrack(name: str) -> None:
+    """Python's resource_tracker thinks every attached segment is ours to
+    unlink at exit; only the owner unlinks. (3.13's track=False, by hand.)
+    Same-process loopback attaches share the owner's tracker entry — leave
+    those registered or the owner's unlink would double-unregister."""
+    if name in _owned_pools:
+        return
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister("/" + name.lstrip("/"), "shared_memory")
+    except Exception:
+        pass
+
+
+class BlockPool:
+    """Our receive staging area — the registered memory region we advertise
+    to the peer (reference rdma/block_pool.cpp). The PEER writes request/
+    response bytes into these blocks; we copy out and return credits."""
+
+    def __init__(self, block_size: int = DEFAULT_BLOCK_SIZE,
+                 block_count: int = DEFAULT_BLOCK_COUNT):
+        self.block_size = block_size
+        self.block_count = block_count
+        self.name = f"brpctpu_{os.getpid():x}_{secrets.token_hex(4)}"
+        self._shm = _shm.SharedMemory(
+            create=True, size=block_size * block_count, name=self.name)
+        _owned_pools.add(self.name)
+        self._closed = False
+
+    def view(self, idx: int, length: int) -> memoryview:
+        if not (0 <= idx < self.block_count and 0 <= length <= self.block_size):
+            raise ValueError(f"bad block ref ({idx},{length})")
+        off = idx * self.block_size
+        return memoryview(self._shm.buf)[off:off + length]
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._shm.close()
+            self._shm.unlink()
+        except Exception:
+            pass
+        _owned_pools.discard(self.name)
+
+
+class PeerWindow:
+    """The sender-side view of the peer's block pool: an attached mapping
+    plus the credit free-list (reference sliding window,
+    rdma_endpoint.h:256-261). acquire() parks the sender when the window is
+    exhausted; ACK frames release() credits and wake it."""
+
+    def __init__(self, name: str, block_size: int, block_count: int):
+        self._shm = _shm.SharedMemory(name=name)
+        _maybe_untrack(name)
+        self.block_size = block_size
+        self.block_count = block_count
+        self._free = deque(range(block_count))
+        self._cond = threading.Condition()
+        self._closed = False
+
+    def acquire(self, want: int, timeout: float = 30.0) -> Optional[List[int]]:
+        """Return 1..want block indices, parking until at least one is free.
+        None on timeout/close (window wedged — peer stopped consuming)."""
+        deadline = _time.monotonic() + timeout
+        with self._cond:
+            while not self._free and not self._closed:
+                left = deadline - _time.monotonic()
+                if left <= 0:
+                    return None
+                self._cond.wait(left)
+            if self._closed:
+                return None
+            take = min(want, len(self._free))
+            return [self._free.popleft() for _ in range(take)]
+
+    def release(self, indices) -> None:
+        with self._cond:
+            self._free.extend(indices)
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        try:
+            self._shm.close()
+        except Exception:
+            pass
+
+
+def _pack_frame(ftype: int, body: bytes = b"") -> bytes:
+    return struct.pack(CTRL_HDR, CTRL_MAGIC, ftype, len(body)) + body
+
+
+class TpuTransportSocket:
+    """The virtual socket (reference: 'a Stream IS a fake Socket'). Exposes
+    the Socket surface the RPC stack uses — write/pending-ids/set_failed on
+    the client side, write/owner_server on the server side — while the bytes
+    actually move through the endpoint's block pools."""
+
+    def __init__(self, endpoint: "TpuEndpoint"):
+        self.endpoint = endpoint
+        self.read_buf = IOBuf()
+        self.preferred_protocol = None
+        self.failed = False
+        self.error_code = 0
+        self.error_text = ""
+        self.remote: Optional[EndPoint] = None
+        self.owner_server = None
+        self.user_data = None
+        self.in_bytes = 0
+        self.out_bytes = 0
+        self.in_messages = 0
+        self.out_messages = 0
+        self.last_active = _time.monotonic()
+        self._pending_ids = set()
+        self._pending_lock = threading.Lock()
+        self.socket_id = _vsock_pool.insert(self)
+
+    # ------------------------------------------------------------ pending ids
+    def add_pending_id(self, cid: int) -> None:
+        with self._pending_lock:
+            self._pending_ids.add(cid)
+
+    def remove_pending_id(self, cid: int) -> None:
+        with self._pending_lock:
+            self._pending_ids.discard(cid)
+
+    # ------------------------------------------------------------- write path
+    def write(self, data, id_wait: Optional[int] = None) -> int:
+        if self.failed:
+            if id_wait is not None:
+                _cid.id_error(id_wait, errors.EFAILEDSOCKET)
+            return errors.EFAILEDSOCKET
+        packet = data if isinstance(data, IOBuf) else IOBuf(bytes(data))
+        if id_wait is not None:
+            self.add_pending_id(id_wait)
+        self.last_active = _time.monotonic()
+        rc = self.endpoint.send_packet(packet)
+        if rc == 0:
+            self.out_messages += 1
+        elif id_wait is not None:
+            self.remove_pending_id(id_wait)
+        return rc
+
+    # ---------------------------------------------------------------- failure
+    def set_failed(self, code: int, reason: str = "") -> None:
+        if code == errors.OK:
+            code = errors.EFAILEDSOCKET
+        if self.failed:
+            return
+        self.failed = True
+        self.error_code = code
+        self.error_text = reason
+        _vsock_pool.remove(self.socket_id)
+        with self._pending_lock:
+            pending = list(self._pending_ids)
+            self._pending_ids.clear()
+        for cid in pending:
+            _cid.id_error(cid, code)
+        self.endpoint.fail(code, reason, from_vsock=True)
+
+    def close(self) -> None:
+        self.set_failed(errors.EFAILEDSOCKET, "closed locally")
+
+    def __repr__(self) -> str:
+        state = "failed" if self.failed else "ok"
+        return f"TpuTransportSocket(remote={self.remote}, {state})"
+
+
+_vsock_pool: VersionedPool = VersionedPool()
+
+
+class TpuEndpoint:
+    """Per-connection transport state hung on the bootstrap Socket
+    (reference RdmaEndpoint inside Socket, rdma_endpoint.h)."""
+
+    def __init__(self, ctrl_sock, role: str, server=None,
+                 target_ordinal: int = 0,
+                 block_size: int = DEFAULT_BLOCK_SIZE,
+                 block_count: int = DEFAULT_BLOCK_COUNT):
+        self.ctrl = ctrl_sock
+        self.role = role                  # "client" | "server"
+        self.server = server              # owning Server (server role)
+        self.target_ordinal = target_ordinal
+        self.recv_pool = BlockPool(block_size, block_count)
+        self.window: Optional[PeerWindow] = None
+        self.inline_only = False          # cross-host fallback
+        self.peer_ordinal = -1
+        self.ready = threading.Event()
+        self._send_lock = threading.Lock()
+        self._failed = False
+        self._fail_lock = threading.Lock()
+        self.vsock = TpuTransportSocket(self)
+        if role == "server":
+            self.vsock.owner_server = server
+            from brpc_tpu.rpc.input_messenger import InputMessenger
+
+            self._messenger = server._messenger if server is not None \
+                else InputMessenger()
+        else:
+            from brpc_tpu.rpc.input_messenger import InputMessenger
+
+            self._messenger = InputMessenger()
+        # bootstrap death must tear down the tunnel and error pending RPCs
+        ctrl_sock.on_failed_hook = lambda code, reason: self.fail(code, reason)
+
+    # --------------------------------------------------------------- handshake
+    def _hello_body(self, ordinal: int, err: str = "") -> bytes:
+        body = {
+            "v": HANDSHAKE_VERSION,
+            "pool": self.recv_pool.name,
+            "bs": self.recv_pool.block_size,
+            "bc": self.recv_pool.block_count,
+            "ordinal": ordinal,
+            "pid": os.getpid(),
+        }
+        if err:
+            body["err"] = err
+        return json.dumps(body).encode()
+
+    def send_hello(self) -> None:
+        self.ctrl.write(_pack_frame(
+            FT_HELLO, self._hello_body(self.target_ordinal)))
+
+    def _attach_peer(self, info: dict) -> None:
+        try:
+            self.window = PeerWindow(info["pool"], info["bs"], info["bc"])
+        except Exception:
+            # different host (or pool gone): inline-frame fallback over DCN
+            self.window = None
+            self.inline_only = True
+        self.peer_ordinal = int(info.get("ordinal", -1))
+
+    def on_hello(self, body: bytes) -> None:
+        """Server side: attach the client's pool, reply with ours. The ACK
+        advertises the device WE front (the RDMA handshake exchanges each
+        side's own GID/QPN) — and a dial addressed to a device this server
+        does not front is refused, not silently served."""
+        info = json.loads(body.decode())
+        requested = int(info.get("ordinal", 0))
+        bound = getattr(self.server, "_tpu_ordinal", -1) \
+            if self.server is not None else -1
+        if bound >= 0 and requested != bound:
+            self.ctrl.write(_pack_frame(FT_HELLO_ACK, self._hello_body(
+                bound, err=f"server fronts device {bound}, "
+                           f"dial requested {requested}")))
+            self.fail(errors.EREQUEST, "device ordinal mismatch")
+            return
+        self._attach_peer(info)
+        self.target_ordinal = requested
+        peer_host = self.ctrl.remote.host if self.ctrl.remote else "?"
+        self.vsock.remote = EndPoint.from_tpu(peer_host, requested)
+        self.ctrl.write(_pack_frame(
+            FT_HELLO_ACK,
+            self._hello_body(bound if bound >= 0 else requested)))
+        self.ready.set()
+
+    def on_hello_ack(self, body: bytes) -> None:
+        """Client side: attach the server's pool; tunnel is up."""
+        info = json.loads(body.decode())
+        err = info.get("err")
+        if err:
+            self.fail(errors.EHOSTDOWN, f"handshake refused: {err}")
+            return
+        self._attach_peer(info)
+        self.ready.set()
+
+    # -------------------------------------------------------------- send path
+    def send_packet(self, packet: IOBuf) -> int:
+        """Ship one RPC packet's bytes through the tunnel. Chunks bigger
+        than the window stream through it (credit flow control); the
+        receiver reassembles from its read_buf, so frame boundaries are
+        invisible to protocols. Bytes are copied ONCE — straight from the
+        packet's IOBuf blocks into the peer's registered blocks (the
+        reference posts IOBuf blocks to the QP the same way,
+        rdma_endpoint.h:89 CutFromIOBufList)."""
+        if self._failed:
+            return errors.EFAILEDSOCKET
+        views = [memoryview(v) for v in packet.iter_blocks() if len(v)]
+        total = sum(len(v) for v in views)
+        with self._send_lock:
+            if self._failed:
+                return errors.EFAILEDSOCKET
+            try:
+                if total <= INLINE_MAX or self.window is None:
+                    rc, partial = self._send_inline(views, total)
+                else:
+                    rc, partial = self._send_blocks(views, total)
+            except Exception:
+                if self._failed:
+                    # fail() released the shm mapping under our feet
+                    # (concurrent BYE/teardown) — a clean error, not a crash
+                    return errors.EFAILEDSOCKET
+                raise
+        if rc != 0 and partial:
+            # frames of this packet already reached the peer's byte stream:
+            # the stream is desynced for good — kill the tunnel, never let
+            # a later packet be parsed against the truncated one
+            self.fail(rc, "mid-packet send failure desynced tunnel stream")
+        return rc
+
+    def _send_inline(self, views, total: int):
+        """Returns (rc, partial): partial=True once any frame was posted."""
+        if total == 0:
+            return 0, False
+        # chunk so a huge DCN-fallback payload can't build one giant frame
+        chunk = DEFAULT_BLOCK_SIZE
+        vi, voff = 0, 0
+        left = total
+        while left > 0:
+            parts = []
+            need = min(chunk, left)
+            part_len = need
+            while need:
+                v = views[vi]
+                take = min(need, len(v) - voff)
+                parts.append(v[voff:voff + take])
+                voff += take
+                need -= take
+                if voff == len(v):
+                    vi += 1
+                    voff = 0
+            frame = IOBuf()
+            frame.append(struct.pack(CTRL_HDR, CTRL_MAGIC, FT_DATA,
+                                     8 + part_len))
+            frame.append(struct.pack(DATA_BODY_HDR, part_len, 0))
+            for p in parts:
+                frame.append(p)
+            rc = self.ctrl.write(frame)
+            if rc != 0:
+                return rc, left != total
+            g_tunnel_out_bytes.put(part_len)
+            left -= part_len
+        return 0, False
+
+    def _send_blocks(self, views, total: int):
+        """Returns (rc, partial): partial=True once any frame was posted."""
+        win = self.window
+        bs = win.block_size
+        sent = 0
+        vi, voff = 0, 0
+        while sent < total:
+            remaining_blocks = -(-(total - sent) // bs)
+            got = win.acquire(min(remaining_blocks, MAX_SEGS_PER_FRAME))
+            if got is None:
+                # window wedged or closed
+                return errors.EOVERCROWDED, sent > 0
+            segs = []
+            for idx in got:
+                # fill this registered block from consecutive source views
+                # — one memcpy per (view, block) intersection, no flatten
+                blk_off = 0
+                base = idx * bs
+                buf = win._shm.buf
+                while blk_off < bs and sent < total:
+                    v = views[vi]
+                    take = min(bs - blk_off, len(v) - voff)
+                    buf[base + blk_off:base + blk_off + take] = \
+                        v[voff:voff + take]
+                    blk_off += take
+                    voff += take
+                    sent += take
+                    if voff == len(v):
+                        vi += 1
+                        voff = 0
+                if blk_off:
+                    segs.append((idx, blk_off))
+                if sent >= total:
+                    break
+            unused = got[len(segs):]
+            if unused:  # blocks we grabbed but didn't need go straight back
+                win.release(unused)
+            body = struct.pack(DATA_BODY_HDR, 0, len(segs))
+            body += b"".join(struct.pack(SEG_FMT, i, ln) for i, ln in segs)
+            rc = self.ctrl.write(_pack_frame(FT_DATA, body))
+            if rc != 0:
+                return rc, sent > sum(ln for _, ln in segs)
+            g_tunnel_out_bytes.put(sum(ln for _, ln in segs))
+        return 0, False
+
+    # -------------------------------------------------------------- recv path
+    def on_data(self, body: bytes) -> None:
+        """Runs inline on the dispatcher parse loop — append stream bytes in
+        arrival order, ACK the consumed blocks, cut complete messages
+        (processing itself fans out to fiber workers in cut_messages)."""
+        inline_len, nsegs = struct.unpack_from(DATA_BODY_HDR, body)
+        vsock = self.vsock
+        got = 0
+        if inline_len:
+            payload = body[8:8 + inline_len]
+            vsock.read_buf.append(payload)
+            got += len(payload)
+        if nsegs:
+            acks = []
+            off = 8
+            for _ in range(nsegs):
+                idx, ln = struct.unpack_from(SEG_FMT, body, off)
+                off += _SEG_SIZE
+                # copy out of the registered block before returning credit
+                vsock.read_buf.append(bytes(self.recv_pool.view(idx, ln)))
+                acks.append(idx)
+                got += ln
+            ack_body = struct.pack("!I", len(acks))
+            ack_body += b"".join(struct.pack("!I", i) for i in acks)
+            if self.ctrl.write(_pack_frame(FT_ACK, ack_body)) != 0:
+                # a lost ACK permanently leaks the peer's credits — the
+                # stream contract is broken, tear the tunnel down
+                self.fail(errors.EFAILEDSOCKET, "ACK write failed")
+                return
+        vsock.in_bytes += got
+        vsock.last_active = _time.monotonic()
+        g_tunnel_in_bytes.put(got)
+        self._messenger.cut_messages(vsock)
+
+    def on_ack(self, body: bytes) -> None:
+        (n,) = struct.unpack_from("!I", body)
+        indices = struct.unpack_from(f"!{n}I", body, 4) if n else ()
+        if self.window is not None:
+            self.window.release(indices)
+
+    # ---------------------------------------------------------------- failure
+    def fail(self, code: int, reason: str = "", from_vsock: bool = False) -> None:
+        with self._fail_lock:
+            if self._failed:
+                return
+            self._failed = True
+        self.ready.set()
+        if not from_vsock:
+            self.vsock.set_failed(code, reason)
+        if self.window is not None:
+            self.window.close()
+        self.recv_pool.close()
+        if not self.ctrl.failed:
+            self.ctrl.set_failed(code if code else errors.EFAILEDSOCKET,
+                                 f"tpu tunnel down: {reason}")
+
+    def close(self) -> None:
+        try:
+            self.ctrl.write(_pack_frame(FT_BYE))
+        except Exception:
+            pass
+        self.fail(errors.EFAILEDSOCKET, "closed locally")
+
+
+class TpuCtrlProtocol(Protocol):
+    """The control-plane protocol: registered like any other, so a plain
+    Server accepts tpu tunnel connections with zero special-casing — the
+    TPUC magic routes here, HELLO upgrades the connection to a TpuEndpoint
+    (the reference's AppConnect handshake-then-switch pattern,
+    rdma_endpoint.cpp ProcessHandshakeAtServer)."""
+
+    name = "tpu_ctrl"
+    magic = CTRL_MAGIC
+    stateful = True        # parse() wants the socket (endpoint state)
+    inline_process = True  # frame order IS stream byte order
+
+    MAX_FRAME = 16 * 1024 * 1024
+
+    def parse(self, buf: IOBuf, sock=None) -> Tuple[int, Optional[ParsedMessage]]:
+        if len(buf) < CTRL_HDR_SIZE:
+            head = buf.fetch(min(len(buf), 4))
+            if head and not CTRL_MAGIC.startswith(head):
+                return PARSE_TRY_OTHERS, None
+            return PARSE_NOT_ENOUGH_DATA, None
+        magic, ftype, blen = struct.unpack(CTRL_HDR, buf.fetch(CTRL_HDR_SIZE))
+        if magic != CTRL_MAGIC:
+            return PARSE_TRY_OTHERS, None
+        if not (FT_HELLO <= ftype <= FT_BYE) or blen > self.MAX_FRAME:
+            return PARSE_BAD, None
+        if len(buf) < CTRL_HDR_SIZE + blen:
+            return PARSE_NOT_ENOUGH_DATA, None
+        buf.pop_front(CTRL_HDR_SIZE)
+        body = buf.cutn(blen).tobytes()
+        return 0, ParsedMessage(self, ftype, IOBuf(body))
+
+    def process(self, msg: ParsedMessage, server) -> None:
+        sock = msg.socket
+        ftype = msg.meta
+        body = msg.body.tobytes()
+        ep: Optional[TpuEndpoint] = getattr(sock, "_tpu_endpoint", None)
+        if ftype == FT_HELLO:
+            if ep is None:
+                ep = TpuEndpoint(sock, role="server", server=server)
+                sock._tpu_endpoint = ep
+                sock.user_data = ep
+                if server is not None:
+                    server._register_tpu_endpoint(ep)
+            ep.on_hello(body)
+            return
+        if ep is None:
+            sock.set_failed(errors.EREQUEST, "tpu ctrl frame before HELLO")
+            return
+        if ftype == FT_HELLO_ACK:
+            ep.on_hello_ack(body)
+        elif ftype == FT_DATA:
+            ep.on_data(body)
+        elif ftype == FT_ACK:
+            ep.on_ack(body)
+        elif ftype == FT_BYE:
+            ep.fail(errors.EFAILEDSOCKET, "peer sent BYE")
+
+
+# ---------------------------------------------------------------------------
+# client-side connection management (the SocketMap of the tunnel world)
+# ---------------------------------------------------------------------------
+_remote_sockets: Dict[Tuple[str, int, int], TpuTransportSocket] = {}
+_remote_lock = threading.Lock()
+
+
+def connect_tpu(ep: EndPoint, connect_timeout: float = 3.0) -> TpuTransportSocket:
+    """Dial a remote tpu:// endpoint: TCP bootstrap, HELLO handshake, block
+    pools attached — returns the virtual socket the client stack writes to."""
+    from brpc_tpu.rpc.event_dispatcher import global_dispatcher
+    from brpc_tpu.rpc.protocol import find_protocol
+    from brpc_tpu.rpc.socket import Socket
+
+    key = (ep.host, ep.port, ep.device_ordinal)
+    with _remote_lock:
+        vs = _remote_sockets.get(key)
+        if vs is not None and not vs.failed:
+            return vs
+    from brpc_tpu.rpc.input_messenger import InputMessenger
+
+    boot = Socket.connect(EndPoint.from_ip_port(ep.host, ep.port),
+                          global_dispatcher(), timeout=connect_timeout)
+    boot.preferred_protocol = find_protocol("tpu_ctrl")
+    endpoint = TpuEndpoint(boot, role="client",
+                           target_ordinal=max(ep.device_ordinal, 0))
+    boot._tpu_endpoint = endpoint
+    boot.user_data = endpoint
+    endpoint.vsock.remote = ep
+    messenger = InputMessenger()
+    boot._on_readable = messenger.make_on_readable(boot)
+    boot.register_read()
+    endpoint.send_hello()
+    if not endpoint.ready.wait(connect_timeout):
+        endpoint.fail(errors.EHOSTDOWN, "tpu handshake timeout")
+        raise ConnectionError(f"tpu handshake with {ep} timed out")
+    if endpoint.vsock.failed:
+        raise ConnectionError(
+            f"tpu handshake with {ep} failed: {endpoint.vsock.error_text}")
+    with _remote_lock:
+        cur = _remote_sockets.get(key)
+        if cur is not None and not cur.failed:
+            endpoint.close()
+            return cur
+        _remote_sockets[key] = endpoint.vsock
+        return endpoint.vsock

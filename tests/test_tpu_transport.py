@@ -1,0 +1,335 @@
+"""Cross-process tpu:// transport tests (VERDICT r1 #1 — the graft).
+
+Pattern follows the reference's RPC integration tests (SURVEY §4): real
+sockets, no mock transport. The multi-process test is the round's
+acceptance criterion: a Server in process A serving RPCs issued by a
+Channel in process B over a tpu:// endpoint, bytes staged through the
+shared-memory registered block pool (reference RdmaEndpoint blueprint,
+rdma_endpoint.cpp:127-130 handshake, block_pool.cpp, sliding window
+rdma_endpoint.h:256-261).
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.proto import echo_pb2
+from brpc_tpu.rpc import (
+    Channel,
+    ChannelOptions,
+    Controller,
+    Server,
+    ServerOptions,
+    Service,
+    Stub,
+)
+
+ECHO = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+
+class EchoServiceImpl(Service):
+    DESCRIPTOR = ECHO
+
+    def Echo(self, cntl, request, done):
+        cntl.response_attachment = cntl.request_attachment
+        return echo_pb2.EchoResponse(message=request.message,
+                                     payload=request.payload)
+
+
+@pytest.fixture()
+def tpu_server():
+    server = Server(ServerOptions())
+    server.add_service(EchoServiceImpl())
+    server.start("tpu://127.0.0.1:0/0")
+    yield server
+    server.stop()
+    server.join()
+
+
+def _stub_for(server, timeout_ms=10000):
+    channel = Channel(ChannelOptions(protocol="trpc_std",
+                                     timeout_ms=timeout_ms))
+    channel.init(str(server.listen_endpoint()))
+    return Stub(channel, ECHO)
+
+
+class TestTunnelLoopback:
+    """Client and server roles in one process, but the full transport in
+    between: TCP bootstrap, HELLO handshake, shm block pool, credits."""
+
+    def test_endpoint_is_tpu_scheme(self, tpu_server):
+        ep = tpu_server.listen_endpoint()
+        assert ep.is_tpu() and ep.port != 0
+        assert str(ep).startswith("tpu://")
+
+    def test_small_inline_echo(self, tpu_server):
+        stub = _stub_for(tpu_server)
+        cntl = Controller()
+        cntl.request_attachment = b"tail"
+        r = stub.Echo(echo_pb2.EchoRequest(message="hello"), controller=cntl)
+        assert r.message == "hello"
+        assert cntl.response_attachment == b"tail"
+
+    def test_block_path_roundtrip(self, tpu_server):
+        stub = _stub_for(tpu_server)
+        payload = bytes(range(256)) * (1024 * 1024 // 256)  # 1MB, patterned
+        r = stub.Echo(echo_pb2.EchoRequest(message="big", payload=payload))
+        assert r.payload == payload
+
+    def test_payload_larger_than_window_streams(self, tpu_server):
+        # 24MB > the 16MB credit window: must stream, not deadlock
+        stub = _stub_for(tpu_server, timeout_ms=60000)
+        payload = b"\xab" * (24 * 1024 * 1024)
+        r = stub.Echo(echo_pb2.EchoRequest(message="huge", payload=payload))
+        assert r.payload == payload
+
+    def test_attachment_rides_blocks(self, tpu_server):
+        stub = _stub_for(tpu_server)
+        att = b"A" * (300 * 1024)  # bigger than one 256KB block
+        cntl = Controller()
+        cntl.request_attachment = att
+        r = stub.Echo(echo_pb2.EchoRequest(message="m"), controller=cntl)
+        assert cntl.response_attachment == att
+
+    def test_concurrent_clients_interleave_safely(self, tpu_server):
+        stub = _stub_for(tpu_server, timeout_ms=30000)
+        errs = []
+
+        def worker(i):
+            try:
+                payload = bytes([i]) * (512 * 1024 + i)
+                for _ in range(3):
+                    r = stub.Echo(echo_pb2.EchoRequest(message=str(i),
+                                                       payload=payload))
+                    assert r.payload == payload, f"worker {i} corrupted"
+            except Exception as e:  # noqa: BLE001
+                errs.append((i, e))
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert not errs, errs
+
+    def test_pipelined_async_calls(self, tpu_server):
+        channel = Channel(ChannelOptions(protocol="trpc_std",
+                                         timeout_ms=30000))
+        channel.init(str(tpu_server.listen_endpoint()))
+        stub = Stub(channel, ECHO)
+        done_evt = threading.Event()
+        results = []
+        n = 20
+
+        def make_done(i):
+            def done(cntl):
+                results.append((i, cntl.error_code,
+                                cntl.response.message if cntl.response else ""))
+                if len(results) == n:
+                    done_evt.set()
+            return done
+
+        for i in range(n):
+            stub.Echo(echo_pb2.EchoRequest(message=f"m{i}"),
+                      done=make_done(i))
+        assert done_evt.wait(30)
+        assert sorted(m for _, code, m in results if code == 0) == \
+            sorted(f"m{i}" for i in range(n))
+
+    def test_server_stop_fails_pending_cleanly(self):
+        server = Server(ServerOptions())
+        svc = Service()
+
+        gate = threading.Event()
+
+        def slow(cntl, request, done):
+            gate.wait(5)
+            return echo_pb2.EchoResponse(message="late")
+
+        svc.add_method("Echo", slow, echo_pb2.EchoRequest,
+                       echo_pb2.EchoResponse)
+        svc.__class__.service_name = property(lambda self: "EchoService")
+        server.add_service(svc)
+        server.start("tpu://127.0.0.1:0/0")
+        stub = _stub_for(server, timeout_ms=2000)
+        cntl = Controller()
+        finished = threading.Event()
+        stub.Echo(echo_pb2.EchoRequest(message="x"), controller=cntl,
+                  done=lambda _c: finished.set())
+        time.sleep(0.2)
+        server.stop()
+        server.join(timeout=0.5)
+        gate.set()
+        assert finished.wait(5)
+        # either the late response made it before teardown or the call
+        # failed with a socket/timeout error — never a hang
+        server.join()
+
+
+class TestOrdinalAddressing:
+    def test_wrong_ordinal_refused(self, tpu_server):
+        # server fronts device 0; dialing /3 must be refused at handshake
+        ep = tpu_server.listen_endpoint()
+        bad = f"tpu://{ep.host}:{ep.port}/3"
+        channel = Channel(ChannelOptions(protocol="trpc_std",
+                                         timeout_ms=3000, max_retry=0))
+        channel.init(bad)
+        stub = Stub(channel, ECHO)
+        from brpc_tpu.rpc.channel import RpcError
+
+        with pytest.raises((RpcError, ConnectionError)):
+            stub.Echo(echo_pb2.EchoRequest(message="x"))
+        # the right ordinal still works
+        good_stub = _stub_for(tpu_server)
+        assert good_stub.Echo(
+            echo_pb2.EchoRequest(message="ok")).message == "ok"
+
+
+class TestWindowAccounting:
+    def test_credits_return_after_traffic(self, tpu_server):
+        stub = _stub_for(tpu_server)
+        payload = b"z" * (2 * 1024 * 1024)
+        for _ in range(5):
+            r = stub.Echo(echo_pb2.EchoRequest(message="w", payload=payload))
+            assert len(r.payload) == len(payload)
+        # after all RPCs complete the client's view of the server window
+        # must be full again (all credits returned)
+        from brpc_tpu.tpu import transport as tr
+
+        with tr._remote_lock:
+            vs = next(iter(tr._remote_sockets.values()))
+        win = vs.endpoint.window
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with win._cond:
+                if len(win._free) == win.block_count:
+                    break
+            time.sleep(0.01)
+        with win._cond:
+            assert len(win._free) == win.block_count
+
+
+_CHILD_SERVER = r"""
+import sys
+from brpc_tpu.proto import echo_pb2
+from brpc_tpu.rpc import Server, ServerOptions, Service
+
+class EchoServiceImpl(Service):
+    DESCRIPTOR = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+    def Echo(self, cntl, request, done):
+        cntl.response_attachment = cntl.request_attachment
+        return echo_pb2.EchoResponse(message="from-child:" + request.message,
+                                     payload=request.payload)
+
+server = Server(ServerOptions())
+server.add_service(EchoServiceImpl())
+server.start("tpu://127.0.0.1:0/0")
+print(f"LISTENING {server.listen_endpoint()}", flush=True)
+sys.stdin.readline()   # parent closes stdin to stop us
+server.stop(); server.join()
+"""
+
+
+class TestTwoProcesses:
+    """THE acceptance test: Channel in this process, Server in a child
+    process, RPC over tpu:// with payload through the shm block pool."""
+
+    @pytest.fixture()
+    def child_server(self):
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _CHILD_SERVER],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        line = proc.stdout.readline().strip()
+        assert line.startswith("LISTENING "), (
+            line, proc.stderr.read() if proc.poll() is not None else "")
+        yield line.split(" ", 1)[1]
+        try:
+            proc.stdin.close()
+            proc.wait(10)
+        except Exception:
+            proc.kill()
+
+    def test_cross_process_echo(self, child_server):
+        channel = Channel(ChannelOptions(protocol="trpc_std",
+                                         timeout_ms=15000))
+        channel.init(child_server)
+        stub = Stub(channel, ECHO)
+        r = stub.Echo(echo_pb2.EchoRequest(message="ping"))
+        assert r.message == "from-child:ping"
+
+    def test_cross_process_bulk_payload(self, child_server):
+        channel = Channel(ChannelOptions(protocol="trpc_std",
+                                         timeout_ms=30000))
+        channel.init(child_server)
+        stub = Stub(channel, ECHO)
+        payload = bytes(range(256)) * (4 * 1024 * 1024 // 256)
+        cntl = Controller()
+        cntl.request_attachment = b"side-channel"
+        r = stub.Echo(echo_pb2.EchoRequest(message="bulk", payload=payload),
+                      controller=cntl)
+        assert r.payload == payload
+        assert cntl.response_attachment == b"side-channel"
+
+    def test_cross_process_concurrent(self, child_server):
+        channel = Channel(ChannelOptions(protocol="trpc_std",
+                                         timeout_ms=30000))
+        channel.init(child_server)
+        stub = Stub(channel, ECHO)
+        errs = []
+
+        def worker(i):
+            try:
+                payload = bytes([i]) * (256 * 1024 * (1 + i % 3))
+                r = stub.Echo(echo_pb2.EchoRequest(message=str(i),
+                                                   payload=payload))
+                assert r.payload == payload
+                assert r.message == f"from-child:{i}"
+            except Exception as e:  # noqa: BLE001
+                errs.append((i, repr(e)))
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert not errs, errs
+
+    def test_tunnel_failure_errors_inflight_and_reconnects(self, child_server):
+        channel = Channel(ChannelOptions(protocol="trpc_std",
+                                         timeout_ms=10000, max_retry=0))
+        channel.init(child_server)
+        stub = Stub(channel, ECHO)
+        # prove liveness first
+        stub.Echo(echo_pb2.EchoRequest(message="alive"))
+        from brpc_tpu.rpc import errors as _errors
+        from brpc_tpu.tpu import transport as tr
+
+        with tr._remote_lock:
+            vs = [s for s in tr._remote_sockets.values() if not s.failed][0]
+        # a call id pending on the tunnel when it dies must get the socket
+        # error through the error channel (reference Socket::SetFailed fanout)
+        codes = []
+        evt = threading.Event()
+        from brpc_tpu.fiber import call_id as _cid
+
+        cid = _cid.id_create(
+            data=None,
+            on_error=lambda d, c, code: (codes.append(code),
+                                         _cid.id_unlock_and_destroy(c),
+                                         evt.set()))
+        vs.add_pending_id(cid)
+        vs.close()
+        assert evt.wait(5)
+        assert codes == [_errors.EFAILEDSOCKET]
+        # ...and the next call transparently re-dials a fresh tunnel
+        r = stub.Echo(echo_pb2.EchoRequest(message="recovered"))
+        assert r.message == "from-child:recovered"
