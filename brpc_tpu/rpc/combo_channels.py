@@ -43,26 +43,45 @@ class CallMapper:
 
 class ResponseMerger:
     """Folds one sub-response into the main response
-    (parallel_channel.h:127). Default: protobuf MergeFrom."""
+    (parallel_channel.h:127). Default: protobuf MergeFrom.
+
+    merge() returns MERGED (0) on success, FAIL to count the sub-call as one
+    failure against fail_limit, or FAIL_ALL to fail the whole parallel call
+    (reference parallel_channel.h:128-140 Result enum).
+    """
+
+    MERGED = 0
+    FAIL = 1
+    FAIL_ALL = 2
 
     def merge(self, response, sub_response) -> int:
         if response is not None and sub_response is not None:
             response.MergeFrom(sub_response)
-        return 0
+        return self.MERGED
 
 
 class ParallelChannel:
     """One RPC -> all sub-channels concurrently; responses merged.
 
-    fail_limit: the call fails once this many sub-calls failed
-    (default: all must fail to fail the whole call... reference default is
-    "any failure fails" only when fail_limit==1; ours defaults to
-    len(channels), i.e. succeed if at least one succeeds, unless set).
+    Reference semantics (parallel_channel.h:161-174, .cpp:223-235):
+
+    - ``fail_limit`` (default: number of issued sub-calls; clamped to
+      [1, issued] like the reference .cpp:661-667): the call fails as soon
+      as this many sub-calls failed; remaining sub-calls are canceled
+      (ECANCELED) and the whole call completes immediately.
+    - ``success_limit`` (only honored when fail_limit is unset): the call
+      completes successfully as soon as this many sub-calls succeeded;
+      remaining sub-calls are canceled with EPCHANFINISH, which is not
+      counted as a sub-call error. Note it is an early-RETURN knob, not a
+      quorum: like the reference, if the fan-out exhausts with fewer
+      successes (but not every sub-call failed) the call still succeeds.
     """
 
-    def __init__(self, fail_limit: Optional[int] = None):
+    def __init__(self, fail_limit: Optional[int] = None,
+                 success_limit: Optional[int] = None):
         self._subs: List[Tuple[Channel, CallMapper, ResponseMerger]] = []
         self.fail_limit = fail_limit
+        self.success_limit = success_limit if fail_limit is None else None
 
     def add_channel(self, channel: Channel,
                     call_mapper: Optional[CallMapper] = None,
@@ -87,8 +106,16 @@ class ParallelChannel:
             if sub is SKIP or sub is None:
                 continue
             mapped.append((channel, merger, sub))
-        # fail threshold counts ISSUED sub-calls; skipped ones can't fail
+        # limits count ISSUED sub-calls; skipped ones can't fail. Clamp to
+        # [1, issued] (reference .cpp:661-678) so fail_limit > issued can't
+        # turn an all-fail fan-out into a silent empty success.
         fail_limit = self.fail_limit if self.fail_limit else len(mapped)
+        fail_limit = max(1, min(fail_limit, len(mapped))) if mapped else 1
+        success_limit = (self.success_limit
+                         if self.fail_limit is None and self.success_limit
+                         else len(mapped))
+        success_limit = (max(1, min(success_limit, len(mapped)))
+                         if mapped else 1)
         if not mapped:
             cntl.set_failed(errors.EREQUEST, "all sub-calls skipped")
             if done is not None:
@@ -99,62 +126,135 @@ class ParallelChannel:
         state = {
             "pending": len(mapped),
             "failed": 0,
+            "succeeded": 0,
             "first_error": None,
+            "finished": False,
+            "sub_cntls": [],
             "lock": threading.Lock(),
             "event": threading.Event(),
         }
         merge_lock = threading.Lock()
 
-        def finish():
-            if state["failed"] >= fail_limit:
-                code, text = state["first_error"]
-                cntl.set_failed(errors.ETOOMANYFAILS,
-                                f"{state['failed']}/{len(mapped)} sub-calls "
-                                f"failed, first: [E{code}] {text}")
-            state["event"].set()
-            if done is not None:
+        def cancel_sub(sc, code: int) -> None:
+            from brpc_tpu.rpc.controller import _fire_id_error
+
+            cid = sc.call_id()
+            if cid is not None:
                 try:
-                    done(cntl)
+                    _fire_id_error(cid, code)
                 except Exception:
                     pass
 
+        def cancel_outstanding(code: int) -> None:
+            """Cancel sub-calls still in flight once a limit decides the
+            outcome (reference .cpp:230-240 bthread_id_error fanout)."""
+            for sc in state["sub_cntls"]:
+                cancel_sub(sc, code)
+
+        def finish(cancel_code: Optional[int] = None):
+            # merge_lock serializes with in-flight merger.merge() calls: a
+            # failure-path finish must not run done() while another sub_done
+            # is still writing into the caller's response
+            with merge_lock:
+                if state["failed"] >= fail_limit:
+                    code, text = state["first_error"]
+                    cntl.set_failed(
+                        errors.ETOOMANYFAILS,
+                        f"{state['failed']}/{len(mapped)} sub-calls "
+                        f"failed, first: [E{code}] {text}")
+                if cancel_code is not None:
+                    cancel_outstanding(cancel_code)
+                state["event"].set()
+                if done is not None:
+                    try:
+                        done(cntl)
+                    except Exception:
+                        pass
+
         def make_done(merger, sub):
             def sub_done(sub_cntl):
-                merge_rc = 0
-                if not sub_cntl.failed():
+                merge_rc = ResponseMerger.MERGED
+                sub_err = sub_cntl.failed()
+                # EPCHANFINISH = we finished early on success_limit; not an
+                # error of the sub-call (reference .cpp:220-221)
+                canceled_by_finish = (sub_err and sub_cntl.error_code
+                                      == errors.EPCHANFINISH)
+                if not sub_err:
                     with merge_lock:
-                        try:
-                            merge_rc = merger.merge(response,
-                                                    sub_cntl.response) or 0
-                        except Exception:
-                            merge_rc = -1
+                        if not state["finished"]:
+                            try:
+                                merge_rc = merger.merge(
+                                    response, sub_cntl.response)
+                                merge_rc = (ResponseMerger.MERGED
+                                            if merge_rc is None else merge_rc)
+                            except Exception:
+                                # a merger that THROWS may have left the main
+                                # response partially mutated — same poison the
+                                # reference's default-merger catch treats as
+                                # whole-call failure (.cpp:317-321); mergers
+                                # signal per-sub failure by returning FAIL
+                                merge_rc = ResponseMerger.FAIL_ALL
                 with state["lock"]:
-                    if sub_cntl.failed() or merge_rc != 0:
-                        # a merger failure fails the sub-call (reference
-                        # counts it against fail_limit)
-                        state["failed"] += 1
+                    if state["finished"]:
+                        return
+                    if merge_rc == ResponseMerger.FAIL_ALL:
+                        # merger demands the whole call fail
+                        state["failed"] = len(mapped)
+                        fail_all = True
                         if state["first_error"] is None:
-                            if sub_cntl.failed():
-                                state["first_error"] = (sub_cntl.error_code,
-                                                        sub_cntl.error_text())
-                            else:
-                                state["first_error"] = (
-                                    errors.ERESPONSE,
-                                    f"response merger failed ({merge_rc})")
+                            state["first_error"] = (
+                                errors.ERESPONSE, "response merger FAIL_ALL")
+                    else:
+                        fail_all = False
+                        if ((sub_err and not canceled_by_finish)
+                                or merge_rc != ResponseMerger.MERGED):
+                            # a merger FAIL counts against fail_limit
+                            # (parallel_channel.h:132-136)
+                            state["failed"] += 1
+                            if state["first_error"] is None:
+                                if sub_err:
+                                    state["first_error"] = (
+                                        sub_cntl.error_code,
+                                        sub_cntl.error_text())
+                                else:
+                                    state["first_error"] = (
+                                        errors.ERESPONSE,
+                                        f"response merger failed ({merge_rc})")
+                        elif not sub_err:
+                            state["succeeded"] += 1
                     state["pending"] -= 1
-                    last = state["pending"] == 0
-                if last:
-                    finish()
+                    cancel_code = None
+                    if fail_all or state["failed"] >= fail_limit:
+                        cancel_code = errors.ECANCELED
+                    elif state["succeeded"] >= success_limit:
+                        cancel_code = errors.EPCHANFINISH
+                    if cancel_code is None and state["pending"] > 0:
+                        return
+                    state["finished"] = True
+                finish(cancel_code if state["pending"] > 0 else None)
 
             return sub_done
 
         for channel, merger, sub in mapped:
-            sub_cntl = Controller()
-            sub_cntl.timeout_ms = cntl.timeout_ms
+            with state["lock"]:
+                # an inline sub-call failure can finish the whole call while
+                # we are still issuing — don't launch sub-calls the finish
+                # already "canceled" (they were never in sub_cntls)
+                if state["finished"]:
+                    break
+                sub_cntl = Controller()
+                sub_cntl.timeout_ms = cntl.timeout_ms
+                state["sub_cntls"].append(sub_cntl)
             channel.call_method(sub.method, sub.request,
                                 response=sub.response,
                                 controller=sub_cntl,
                                 done=make_done(merger, sub))
+            with state["lock"]:
+                raced = state["finished"]
+            if raced:
+                # finish() ran during this call_method; its cancel fanout may
+                # have missed this freshly-created id — cancel it directly
+                cancel_sub(sub_cntl, errors.ECANCELED)
         if done is not None:
             return cntl
         state["event"].wait()
@@ -216,10 +316,16 @@ class SelectiveChannel:
                     break
                 sub_cntl = Controller()
                 sub_cntl.timeout_ms = cntl.timeout_ms
+                # each attempt gets an ISOLATED response: a failed attempt
+                # that partially filled its response must not leak state
+                # into the next attempt or the caller's object (reference
+                # selective_channel.cpp sub-call isolation)
+                sub_resp = (method.response_class()
+                            if method.response_class else None)
                 start = _time.perf_counter_ns() // 1000
                 try:
                     out = self._subs[idx].call_method(
-                        method, request, response=response,
+                        method, request, response=sub_resp,
                         controller=sub_cntl)
                 except RpcError as e:
                     self._states[idx].on_feedback(
@@ -229,6 +335,10 @@ class SelectiveChannel:
                     continue
                 self._states[idx].on_feedback(
                     errors.OK, _time.perf_counter_ns() // 1000 - start)
+                if response is not None and out is not None \
+                        and out is not response:
+                    response.CopyFrom(out)
+                    out = response
                 cntl._response = out
                 return out
             if last_err is not None and not cntl.failed():
@@ -272,8 +382,9 @@ class PartitionChannel(ParallelChannel):
     """Shards one naming-service server list into N partitions; each call
     fans out one sub-call per partition (partition_channel.h:46-136)."""
 
-    def __init__(self, fail_limit: Optional[int] = None):
-        super().__init__(fail_limit=fail_limit)
+    def __init__(self, fail_limit: Optional[int] = None,
+                 success_limit: Optional[int] = None):
+        super().__init__(fail_limit=fail_limit, success_limit=success_limit)
         self._partition_lbs = []
         self._ns_thread = None
 

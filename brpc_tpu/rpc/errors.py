@@ -18,6 +18,7 @@ ELIMIT = 1012          # concurrency limiter rejected the request
 EBACKUPREQUEST = 1017  # internal: backup-request timer fired
 ETOOMANYFAILS = 1014   # ParallelChannel: sub-call failures exceeded fail_limit
 ECANCELED = 1015       # call canceled by caller
+EPCHANFINISH = 1018    # internal: ParallelChannel finished early (not an error)
 EINTERNAL = 2001       # server internal error
 ERESPONSE = 2002       # bad response (parse failure / checksum mismatch)
 EAUTH = 2003           # authentication failed
@@ -37,6 +38,7 @@ _TEXT = {
     EBACKUPREQUEST: "backup request triggered",
     ETOOMANYFAILS: "too many sub-call failures",
     ECANCELED: "rpc canceled",
+    EPCHANFINISH: "parallel channel finished early",
     EINTERNAL: "server internal error",
     ERESPONSE: "bad response",
     EAUTH: "authentication failed",

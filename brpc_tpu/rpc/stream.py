@@ -101,8 +101,13 @@ class Stream:
         import time as _time
 
         deadline = (_time.monotonic() + timeout) if timeout is not None else None
-        if not self.bound.wait(timeout if timeout is not None else 10):
+        # timeout=None means wait indefinitely for the stream to bind —
+        # never silently convert it into a fixed budget. close() sets
+        # `bound` so a stream that dies before binding unwedges writers.
+        if not self.bound.wait(timeout):
             return errors.ERPCTIMEDOUT
+        if self.closed:
+            return errors.ESTREAMCLOSED
         n = len(data)
         with self._write_lock:
             # block only while bytes are in flight: a message larger than
@@ -195,6 +200,7 @@ class Stream:
             meta = self._frame_meta(FRAME_CLOSE)
             self.socket.write(pack_stream_frame(meta, b""))
         self._write_butex.add_and_wake()  # unblock writers
+        self.bound.set()  # unwedge write()-ers parked waiting for bind
         _stream_pool.remove(self.stream_id)
         if self.options.on_closed is not None:
             try:

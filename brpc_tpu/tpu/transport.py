@@ -488,6 +488,10 @@ class TpuEndpoint:
             body += b"".join(struct.pack(SEG_FMT, i, ln) for i, ln in segs)
             rc = self.ctrl.write(_pack_frame(FT_DATA, body))
             if rc != 0:
+                # the frame never entered the peer's byte stream — return
+                # the acquired credits, else they leak forever (the peer
+                # can't ACK blocks it never saw) and the window wedges
+                win.release([i for i, _ in segs])
                 return rc, sent > sum(ln for _, ln in segs)
             g_tunnel_out_bytes.put(sum(ln for _, ln in segs))
         return 0, False

@@ -152,6 +152,123 @@ class TestParallelChannel:
             stop_servers(servers)
 
 
+class SlowEcho(Service):
+    DESCRIPTOR = ECHO_DESC
+
+    def __init__(self, name, delay_s=1.0):
+        super().__init__()
+        self.name = name
+        self.delay_s = delay_s
+        self.hits = 0
+
+    def Echo(self, cntl, request, done):
+        self.hits += 1
+        time.sleep(self.delay_s)
+        return echo_pb2.EchoResponse(message=self.name)
+
+
+class TestParallelChannelLimits:
+    """Reference semantics regressions (parallel_channel.cpp:223-235,
+    parallel_channel.h:161-174)."""
+
+    def test_fail_limit_cancels_outstanding(self):
+        # two instant failures + one slow success; fail_limit=2 must fail
+        # the call immediately without waiting for the slow sub-call
+        impls = [NamedEcho("bad1", fail=True), NamedEcho("bad2", fail=True),
+                 SlowEcho("slow", delay_s=2.0)]
+        servers = start_servers(*impls)
+        try:
+            pc = ParallelChannel(fail_limit=2)
+            for s in servers:
+                pc.add_channel(Channel().init(str(s.listen_endpoint())))
+            cntl = Controller()
+            cntl.timeout_ms = 10_000
+            start = time.monotonic()
+            with pytest.raises(RpcError) as ei:
+                pc.call_method(ECHO_MD, echo_pb2.EchoRequest(message="x"),
+                               controller=cntl)
+            elapsed = time.monotonic() - start
+            assert ei.value.error_code == errors.ETOOMANYFAILS
+            assert elapsed < 1.5, f"waited for canceled sub-call: {elapsed}"
+        finally:
+            stop_servers(servers)
+
+    def test_success_limit_finishes_early(self):
+        impls = [NamedEcho("fast"), SlowEcho("slow1", delay_s=2.0),
+                 SlowEcho("slow2", delay_s=2.0)]
+        servers = start_servers(*impls)
+        try:
+            pc = ParallelChannel(success_limit=1)
+            for s in servers:
+                pc.add_channel(Channel().init(str(s.listen_endpoint())))
+            cntl = Controller()
+            cntl.timeout_ms = 10_000
+            start = time.monotonic()
+            resp = pc.call_method(ECHO_MD, echo_pb2.EchoRequest(message="x"),
+                                  controller=cntl)
+            elapsed = time.monotonic() - start
+            assert resp.message == "fast"
+            assert elapsed < 1.5, f"waited past success_limit: {elapsed}"
+        finally:
+            stop_servers(servers)
+
+    def test_fail_limit_clamped_to_issued(self):
+        # fail_limit > #channels must not turn an all-fail fan-out into a
+        # silent empty success (reference clamps to ndone, .cpp:661-667)
+        impls = [NamedEcho("b1", fail=True), NamedEcho("b2", fail=True)]
+        servers = start_servers(*impls)
+        try:
+            pc = ParallelChannel(fail_limit=5)
+            for s in servers:
+                pc.add_channel(Channel().init(str(s.listen_endpoint())))
+            with pytest.raises(RpcError) as ei:
+                pc.call_method(ECHO_MD, echo_pb2.EchoRequest(message="x"))
+            assert ei.value.error_code == errors.ETOOMANYFAILS
+        finally:
+            stop_servers(servers)
+
+    def test_merger_fail_counts_against_fail_limit(self):
+        impls = [NamedEcho("a"), NamedEcho("b")]
+        servers = start_servers(*impls)
+        try:
+            class RejectAll(ResponseMerger):
+                def merge(self, response, sub):
+                    return ResponseMerger.FAIL
+
+            pc = ParallelChannel()  # fail_limit = all
+            for s in servers:
+                pc.add_channel(Channel().init(str(s.listen_endpoint())),
+                               response_merger=RejectAll())
+            with pytest.raises(RpcError) as ei:
+                pc.call_method(ECHO_MD, echo_pb2.EchoRequest(message="x"))
+            assert ei.value.error_code == errors.ETOOMANYFAILS
+        finally:
+            stop_servers(servers)
+
+    def test_merger_fail_all_fails_whole_call(self):
+        impls = [NamedEcho("a"), NamedEcho("b"), NamedEcho("c")]
+        servers = start_servers(*impls)
+        try:
+            class Poison(ResponseMerger):
+                calls = 0
+
+                def merge(self, response, sub):
+                    Poison.calls += 1
+                    if Poison.calls == 1:
+                        return ResponseMerger.FAIL_ALL
+                    return ResponseMerger.MERGED
+
+            pc = ParallelChannel()  # default would tolerate one failure
+            for s in servers:
+                pc.add_channel(Channel().init(str(s.listen_endpoint())),
+                               response_merger=Poison())
+            with pytest.raises(RpcError) as ei:
+                pc.call_method(ECHO_MD, echo_pb2.EchoRequest(message="x"))
+            assert ei.value.error_code == errors.ETOOMANYFAILS
+        finally:
+            stop_servers(servers)
+
+
 class TestSelectiveChannel:
     def test_prefers_healthy_channel(self):
         impls = [NamedEcho("good")]
@@ -179,6 +296,37 @@ class TestSelectiveChannel:
         sc.add_channel(dead)
         with pytest.raises(RpcError):
             sc.call_method(ECHO_MD, echo_pb2.EchoRequest(message="x"))
+
+    def test_failed_attempt_does_not_leak_into_response(self):
+        """A failed attempt that partially filled its response must not
+        contaminate the caller's response object (VERDICT r1 weak #5;
+        reference isolates sub-call responses)."""
+        from brpc_tpu.rpc.channel import RpcError as _RpcError
+
+        class GarbageThenFail:
+            """Fake sub-channel: writes junk into the response, then fails."""
+
+            def call_method(self, method, request, response=None,
+                            controller=None, done=None):
+                if response is not None:
+                    response.message = "GARBAGE"
+                cntl = controller or Controller()
+                cntl.set_failed(errors.EINTERNAL, "injected partial fill")
+                raise _RpcError(cntl)
+
+        impls = [NamedEcho("good")]
+        servers = start_servers(*impls)
+        try:
+            sc = SelectiveChannel()
+            sc.add_channel(GarbageThenFail())
+            sc.add_channel(Channel().init(str(servers[0].listen_endpoint())))
+            caller_resp = echo_pb2.EchoResponse()
+            out = sc.call_method(ECHO_MD, echo_pb2.EchoRequest(message="x"),
+                                 response=caller_resp)
+            assert caller_resp.message == "good"
+            assert out.message == "good"
+        finally:
+            stop_servers(servers)
 
 
 class TestPartitionChannel:
