@@ -168,3 +168,12 @@ rpc_dump_ratio = define(
     "rpc_dump_ratio", 0.0,
     "fraction of requests sampled to dump files",
     validator=lambda v: 0 <= v <= 1)
+event_dispatcher_num = define(
+    "event_dispatcher_num", 2,
+    "number of IO event loops sockets are spread across "
+    "(reference event_dispatcher.cpp:32)", validator=_positive)
+inline_cut_max_bytes = define(
+    "inline_cut_max_bytes", 128 * 1024,
+    "read bursts beyond this are parsed on a fiber worker instead of the "
+    "event loop (reference ProcessEvent handoff, socket.cpp:2256)",
+    validator=_positive)

@@ -1,25 +1,32 @@
-"""EventDispatcher — the IO event loop feeding the fiber runtime.
+"""EventDispatcher — the IO event loops feeding the fiber runtime.
 
-Rebuild of ``event_dispatcher_epoll.cpp:196-206``: one (or more) dedicated
+Rebuild of ``event_dispatcher_epoll.cpp:196-206``: one or more dedicated
 threads blocked in epoll; events never read data themselves — they fire the
 consumer's callback (``AddConsumer``, event_dispatcher.h:122). Registration
 changes from other threads are applied through a self-pipe wakeup so the
 loop never holds stale interest sets.
 
-Read callbacks run on the dispatcher thread (which drains the fd and hands
-complete messages to fiber workers — the reference's ProcessEvent handoff
-happens at the message level, SURVEY §3.1); write callbacks drain pending
-write queues.
+Like the reference (``event_dispatcher.cpp:32,59-78`` —
+``event_dispatcher_num`` loops), a pool of dispatchers shares the fd space:
+each new socket is assigned round-robin via :func:`pick_dispatcher`, so one
+connection's burst can't monopolize every socket's event delivery. A socket
+whose read buffer grows past the inline-cut budget gets its read interest
+suspended while a fiber worker drains and parses it off-loop
+(InputMessenger._cut_offloaded), then resumed — the analog of the
+reference's ProcessEvent handoff at the first atomic (socket.cpp:2256).
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import selectors
 import threading
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from brpc_tpu.metrics.reducer import Adder
+
+log = logging.getLogger("brpc_tpu.event_dispatcher")
 
 
 class EventDispatcher:
@@ -27,6 +34,7 @@ class EventDispatcher:
         self._selector = selectors.DefaultSelector()
         self._lock = threading.Lock()
         self._handlers: Dict[int, Tuple[Optional[Callable], Optional[Callable]]] = {}
+        self._read_suspended: Set[int] = set()
         self._wake_r, self._wake_w = os.pipe()
         os.set_blocking(self._wake_r, False)
         self._selector.register(self._wake_r, selectors.EVENT_READ, None)
@@ -45,34 +53,73 @@ class EventDispatcher:
             events |= selectors.EVENT_WRITE
         with self._lock:
             self._handlers[fd] = (on_readable, on_writable)
+            self._read_suspended.discard(fd)
             try:
                 self._selector.modify(fd, events, fd)
             except KeyError:
                 self._selector.register(fd, events, fd)
         self._wakeup()
+
+    def _events_for_locked(self, fd: int) -> int:
+        r, w = self._handlers.get(fd, (None, None))
+        events = 0
+        if r and fd not in self._read_suspended:
+            events |= selectors.EVENT_READ
+        if w:
+            events |= selectors.EVENT_WRITE
+        return events
+
+    def _apply_locked(self, fd: int) -> None:
+        events = self._events_for_locked(fd)
+        if not events:
+            try:
+                self._selector.unregister(fd)
+            except KeyError:
+                pass
+            return
+        try:
+            self._selector.modify(fd, events, fd)
+        except KeyError:
+            try:
+                self._selector.register(fd, events, fd)
+            except (ValueError, OSError):
+                pass
 
     def enable_write(self, fd: int, on_writable: Callable) -> None:
         with self._lock:
             r, _ = self._handlers.get(fd, (None, None))
             self._handlers[fd] = (r, on_writable)
-            events = selectors.EVENT_WRITE | (selectors.EVENT_READ if r else 0)
-            try:
-                self._selector.modify(fd, events, fd)
-            except KeyError:
-                self._selector.register(fd, events, fd)
+            self._apply_locked(fd)
         self._wakeup()
 
     def disable_write(self, fd: int) -> None:
         with self._lock:
             r, _ = self._handlers.get(fd, (None, None))
+            if r is None and fd not in self._handlers:
+                return
             self._handlers[fd] = (r, None)
-            if r:
-                try:
-                    self._selector.modify(fd, selectors.EVENT_READ, fd)
-                except KeyError:
-                    pass
-            else:
+            if r is None:
                 self._remove_locked(fd)
+            else:
+                self._apply_locked(fd)
+        self._wakeup()
+
+    def suspend_read(self, fd: int) -> None:
+        """Stop delivering read events while an off-loop cutter owns the
+        socket's read side; write interest is preserved."""
+        with self._lock:
+            if fd not in self._handlers:
+                return
+            self._read_suspended.add(fd)
+            self._apply_locked(fd)
+        self._wakeup()
+
+    def resume_read(self, fd: int) -> None:
+        with self._lock:
+            if fd not in self._handlers:
+                return
+            self._read_suspended.discard(fd)
+            self._apply_locked(fd)
         self._wakeup()
 
     def remove_consumer(self, fd: int) -> None:
@@ -82,6 +129,7 @@ class EventDispatcher:
 
     def _remove_locked(self, fd: int) -> None:
         self._handlers.pop(fd, None)
+        self._read_suspended.discard(fd)
         try:
             self._selector.unregister(fd)
         except KeyError:
@@ -117,30 +165,65 @@ class EventDispatcher:
                     continue
                 with self._lock:
                     on_r, on_w = self._handlers.get(key.fd, (None, None))
+                    if key.fd in self._read_suspended:
+                        on_r = None
                 self.events_dispatched.put(1)
                 if mask & selectors.EVENT_READ and on_r:
                     try:
                         on_r()
                     except Exception:
-                        pass
+                        log.exception("read handler failed (fd=%d)", key.fd)
                 if mask & selectors.EVENT_WRITE and on_w:
                     try:
                         on_w()
                     except Exception:
-                        pass
+                        log.exception("write handler failed (fd=%d)", key.fd)
         try:
             self._selector.close()
         except OSError:
             pass
 
 
-_global: Optional[EventDispatcher] = None
-_global_lock = threading.Lock()
+# --------------------------------------------------------------------- pool
+_pool: List[EventDispatcher] = []
+_pool_lock = threading.Lock()
+_pick_counter = 0
+
+
+def _dispatcher_count() -> int:
+    from brpc_tpu import flags
+
+    try:
+        return max(1, int(flags.get("event_dispatcher_num")))
+    except Exception:
+        return 1
+
+
+def _ensure_pool() -> List[EventDispatcher]:
+    global _pool
+    with _pool_lock:
+        want = _dispatcher_count()
+        while len(_pool) < want:
+            _pool.append(
+                EventDispatcher(name=f"event-dispatcher-{len(_pool)}"))
+        return _pool
+
+
+def pick_dispatcher() -> EventDispatcher:
+    """Round-robin assignment of new sockets across the dispatcher pool
+    (reference: fd-hash over event_dispatcher_num loops)."""
+    global _pick_counter
+    pool = _ensure_pool()
+    with _pool_lock:
+        _pick_counter += 1
+        return pool[_pick_counter % len(pool)]
+
+
+def all_dispatchers() -> List[EventDispatcher]:
+    return _ensure_pool()
 
 
 def global_dispatcher() -> EventDispatcher:
-    global _global
-    with _global_lock:
-        if _global is None:
-            _global = EventDispatcher()
-        return _global
+    """The pool's first loop — kept for callers that need a stable
+    dispatcher (listeners, bootstrap sockets)."""
+    return _ensure_pool()[0]

@@ -12,9 +12,11 @@ in their own per-stream ExecutionQueue.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Optional
 
+from brpc_tpu import flags
 from brpc_tpu.butil.iobuf import IOBuf
 from brpc_tpu.fiber import runtime
 from brpc_tpu.proto import rpc_meta_pb2
@@ -30,6 +32,12 @@ from brpc_tpu.rpc import errors
 from brpc_tpu.rpc.socket import Socket
 
 _tls = threading.local()
+
+log = logging.getLogger("brpc_tpu.input_messenger")
+
+
+def _inline_cut_max() -> int:
+    return int(flags.get("inline_cut_max_bytes"))
 
 
 def _thread_scanner():
@@ -52,15 +60,53 @@ class InputMessenger:
         self._server = server
 
     def make_on_readable(self, sock: Socket):
-        """The dispatcher callback for this socket's read events."""
+        """The dispatcher callback for this socket's read events.
+
+        Small bursts are cut inline on the event loop; once the buffered
+        bytes exceed ``inline_cut_max_bytes`` the socket's read interest is
+        suspended and a fiber worker takes over drain+cut, so one
+        connection flooding large messages can't stall every other socket
+        on this dispatcher (reference hands off at the first atomic,
+        socket.cpp:2256; multiple loops via event_dispatcher_num)."""
 
         def on_readable():
             n = sock.drain_recv()
             if n < 0:
                 return
-            self.cut_messages(sock)
+            if sock._eof or len(sock.read_buf) <= _inline_cut_max():
+                self.cut_messages(sock)
+                if sock._eof and not sock.failed:
+                    # close-after-reply: the reply was parsed above; only
+                    # now may the socket fail (fanning errors to call ids
+                    # still pending)
+                    sock.set_failed(errors.EFAILEDSOCKET, "peer closed")
+                return
+            sock.suspend_read()
+            runtime.start_background(self._cut_offloaded, sock)
 
         return on_readable
+
+    def _cut_offloaded(self, sock: Socket) -> None:
+        """Fiber-side drain+cut loop while the socket's read interest is
+        suspended. Only one cutter runs at a time: the dispatcher can't
+        deliver more read events until resume_read."""
+        try:
+            while True:
+                self.cut_messages(sock)
+                if sock.failed:
+                    return
+                if sock._eof:
+                    sock.set_failed(errors.EFAILEDSOCKET, "peer closed")
+                    return
+                n = sock.drain_recv()
+                if n < 0:
+                    return
+                if n == 0 and not sock._eof:
+                    # kernel buffer empty; leftover bytes (if any) are an
+                    # incomplete message — wait for the next event
+                    return
+        finally:
+            sock.resume_read()
 
     def cut_messages(self, sock: Socket) -> int:
         """Parse complete messages in arrival order, then fan processing out
@@ -179,4 +225,5 @@ def _process_one(msg, server) -> None:
     try:
         msg.protocol.process(msg, server or msg.socket.owner_server)
     except Exception:
-        pass
+        log.exception("%s handler failed (socket=%r)",
+                      msg.protocol.name, msg.socket)

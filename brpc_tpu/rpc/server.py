@@ -17,7 +17,7 @@ from brpc_tpu.butil.endpoint import EndPoint
 from brpc_tpu.metrics.latency_recorder import LatencyRecorder
 from brpc_tpu.metrics.reducer import Adder
 from brpc_tpu.rpc import errors
-from brpc_tpu.rpc.event_dispatcher import global_dispatcher
+from brpc_tpu.rpc.event_dispatcher import global_dispatcher, pick_dispatcher
 from brpc_tpu.rpc.input_messenger import InputMessenger
 from brpc_tpu.rpc.socket import Socket
 
@@ -260,7 +260,9 @@ class Server:
             except OSError:
                 pass
             remote = EndPoint.from_ip_port(*peer[:2]) if isinstance(peer, tuple) else None
-            sock = Socket(conn, remote, self._dispatcher)
+            # accepted connections spread across the dispatcher pool; only
+            # the listener stays pinned to self._dispatcher
+            sock = Socket(conn, remote, pick_dispatcher())
             sock.owner_server = self
             sock._on_readable = self._messenger.make_on_readable(sock)
             sock.register_read()

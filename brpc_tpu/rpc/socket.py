@@ -51,6 +51,7 @@ class Socket:
         self.read_buf = IOBuf()
         self.preferred_protocol = None
         self.failed = False
+        self._eof = False   # clean FIN seen; fail after buffered bytes parse
         self.error_code = 0
         self.error_text = ""
         self._write_lock = threading.Lock()
@@ -183,7 +184,10 @@ class Socket:
 
     # -------------------------------------------------------------- read path
     def drain_recv(self) -> int:
-        """recv until EAGAIN into read_buf; returns bytes read, -1 on EOF."""
+        """recv until EAGAIN into read_buf; returns bytes read, -1 on a hard
+        error. A clean FIN sets ``_eof`` instead of failing immediately so
+        the caller can parse messages that arrived in the same burst
+        (close-after-reply must still deliver the reply)."""
         total = 0
         while True:
             try:
@@ -194,8 +198,8 @@ class Socket:
                 self.set_failed(errors.EFAILEDSOCKET, f"recv: {e}")
                 return -1
             if not chunk:
-                self.set_failed(errors.EFAILEDSOCKET, "peer closed")
-                return -1
+                self._eof = True
+                break
             total += len(chunk)
             self.in_bytes += len(chunk)
             g_in_bytes.put(len(chunk))
@@ -203,6 +207,22 @@ class Socket:
         if total:
             self.last_active = _time.monotonic()
         return total
+
+    def suspend_read(self) -> None:
+        """Park read-event delivery while an off-loop cutter owns the read
+        side. Guarded by the close lock so a concurrent set_failed (which
+        closes the fd — the number may be reused by a brand-new socket)
+        can't let us suspend someone else's fd."""
+        with self._close_lock:
+            if self.failed:
+                return
+            self.dispatcher.suspend_read(self.fd)
+
+    def resume_read(self) -> None:
+        with self._close_lock:
+            if self.failed:
+                return
+            self.dispatcher.resume_read(self.fd)
 
     # ---------------------------------------------------------------- failure
     def set_failed(self, code: int, reason: str = "") -> None:

@@ -20,6 +20,8 @@ class SocketMap:
     connections; same-signature channels share one."""
 
     def __init__(self, dispatcher, messenger):
+        # dispatcher=None spreads new connections across the pool
+        # (pick_dispatcher); a concrete dispatcher pins them
         self._dispatcher = dispatcher
         self._messenger = messenger
         self._map: Dict[tuple, Socket] = {}
@@ -41,8 +43,13 @@ class SocketMap:
                 sock = self._map.get(key)
                 if sock is not None and not sock.failed:
                     return sock
-            sock = Socket.connect(remote, self._dispatcher,
-                                  timeout=connect_timeout)
+            if self._dispatcher is None:
+                from brpc_tpu.rpc.event_dispatcher import pick_dispatcher
+
+                disp = pick_dispatcher()
+            else:
+                disp = self._dispatcher
+            sock = Socket.connect(remote, disp, timeout=connect_timeout)
             sock._on_readable = self._messenger.make_on_readable(sock)
             sock.register_read()
             with self._lock:
@@ -80,8 +87,7 @@ def global_socket_map() -> SocketMap:
     global _global_map
     with _global_lock:
         if _global_map is None:
-            from brpc_tpu.rpc.event_dispatcher import global_dispatcher
             from brpc_tpu.rpc.input_messenger import InputMessenger
 
-            _global_map = SocketMap(global_dispatcher(), InputMessenger())
+            _global_map = SocketMap(None, InputMessenger())
         return _global_map
