@@ -110,6 +110,12 @@ class TrpcStdProtocol(Protocol):
 
         handle_response_message(msg)
 
+    def claim_cid(self, msg: ParsedMessage):
+        meta = msg.meta
+        if meta.HasField("response"):
+            return meta.correlation_id
+        return None
+
     # --------------------------------------------------------------- helpers
     @staticmethod
     def split_attachment(msg: ParsedMessage) -> Tuple[bytes, bytes]:

@@ -336,7 +336,22 @@ def handle_response_message(msg) -> None:
     try:
         cntl = _cid.id_lock_verify(cid, meta.attempt_version)
     except _cid.IdGone:
-        return  # stale attempt or finished RPC: drop silently
+        # Stale attempt or finished RPC. The cut-time claim_cid removed the
+        # socket's pending entry for this cid; if the call is still LIVE
+        # (newer attempt in flight), restore the entry so a later socket
+        # failure still reaches the call (pre-claim semantics).
+        sock = msg.socket
+        if sock is not None and not sock.failed:
+            try:
+                _cid.id_version(cid)
+            except _cid.IdGone:
+                return  # finished RPC: nothing to restore
+            sock.add_pending_id(cid)
+            if sock.failed:
+                # lost the race with set_failed's fan-out: deliver ourselves
+                sock.remove_pending_id(cid)
+                _cid.id_error(cid, sock.error_code or errors.EFAILEDSOCKET)
+        return
     payload, attachment = msg.protocol.split_attachment(msg)
     if not msg.protocol.verify_checksum(meta, payload):
         cntl.set_failed(errors.ERESPONSE, "response checksum mismatch")

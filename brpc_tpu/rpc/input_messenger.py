@@ -73,14 +73,16 @@ class InputMessenger:
             n = sock.drain_recv()
             if n < 0:
                 return
-            if sock._eof or len(sock.read_buf) <= _inline_cut_max():
+            if len(sock.read_buf) <= _inline_cut_max():
                 self.cut_messages(sock)
                 if sock._eof and not sock.failed:
-                    # close-after-reply: the reply was parsed above; only
-                    # now may the socket fail (fanning errors to call ids
-                    # still pending)
+                    # close-after-reply: replies parsed above already claimed
+                    # their call ids (cut_messages); failing now only errors
+                    # calls whose reply never arrived
                     sock.set_failed(errors.EFAILEDSOCKET, "peer closed")
                 return
+            # over budget — even at EOF the final burst parses off-loop so a
+            # flood-then-close peer can't stall this dispatcher's sockets
             sock.suspend_read()
             runtime.start_background(self._cut_offloaded, sock)
 
@@ -130,6 +132,9 @@ class InputMessenger:
                 msg.socket = sock
                 sock.in_messages += 1
                 count += 1
+                cid = msg.protocol.claim_cid(msg)
+                if cid is not None:
+                    sock.remove_pending_id(cid)
                 if msg.protocol.inline_process:
                     # order-sensitive frames (streams): handle on the serial
                     # parse loop; the handler only enqueues to per-stream

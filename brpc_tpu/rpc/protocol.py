@@ -53,6 +53,16 @@ class Protocol:
         """Try to cut ONE message from buf. Returns (PARSE_*, msg|None)."""
         raise NotImplementedError
 
+    def claim_cid(self, msg: ParsedMessage):
+        """Correlation id this RESPONSE completes, or None.
+
+        Called at cut time, before processing is queued: the cutter removes
+        the id from the socket's pending set so a close-after-reply cannot
+        error a call whose reply is already off the wire (the reply's
+        processing task owns the call's fate from here; the RPC timeout
+        still covers a processing crash)."""
+        return None
+
     def pack_request(self, meta, payload: bytes) -> IOBuf:
         raise NotImplementedError
 

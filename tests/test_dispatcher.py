@@ -192,8 +192,14 @@ class TestFloodIsolation:
             t.join(timeout=40)
             assert not flood_err, flood_err
             lat.sort()
+            # If cutting ran inline, the continuous 16MB parses would stall
+            # essentially EVERY small RPC for >=100ms — so assert on p90
+            # (immune to a stray scheduler hiccup) plus a loose tail bound,
+            # not a tight absolute p99 that flakes on loaded CI machines.
+            p90 = lat[int(len(lat) * 0.90) - 1]
             p99 = lat[int(len(lat) * 0.99) - 1]
-            assert p99 < 0.25, f"small-RPC p99 {p99*1000:.1f}ms under flood"
+            assert p90 < 0.25, f"small-RPC p90 {p90*1000:.1f}ms under flood"
+            assert p99 < 1.0, f"small-RPC p99 {p99*1000:.1f}ms under flood"
         finally:
             server.stop()
             server.join(timeout=5)

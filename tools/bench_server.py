@@ -1,0 +1,50 @@
+"""Echo server subprocess for bench.py and the rdma_performance-style sweep.
+
+Run as a child process so client and server do not share a GIL — the
+reference benchmarks likewise run client and server as separate binaries
+(/root/reference/example/multi_threaded_echo_c++/server.cpp). Prints
+``LISTEN <endpoint>`` once the listener is up, then serves until stdin
+closes (the parent holds the pipe).
+
+    python tools/bench_server.py --listen 127.0.0.1:0
+    python tools/bench_server.py --listen tpu://127.0.0.1:0/0
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from brpc_tpu.proto import echo_pb2  # noqa: E402
+from brpc_tpu.rpc import Server, ServerOptions, Service  # noqa: E402
+
+
+class EchoServiceImpl(Service):
+    DESCRIPTOR = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+    def Echo(self, cntl, request, done):
+        cntl.response_attachment = cntl.request_attachment
+        return echo_pb2.EchoResponse(message=request.message,
+                                     payload=request.payload)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--listen", default="127.0.0.1:0")
+    args = ap.parse_args(argv)
+    server = Server(ServerOptions())
+    server.add_service(EchoServiceImpl())
+    server.start(args.listen)
+    print(f"LISTEN {server.listen_endpoint()}", flush=True)
+    try:
+        sys.stdin.read()  # parent closing the pipe is the stop signal
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+    server.join()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
