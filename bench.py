@@ -59,10 +59,10 @@ def _percentile(sorted_lat, p):
 class _BenchServer:
     """Child echo server; LISTEN line gives the bound endpoint."""
 
-    def __init__(self, listen: str):
+    def __init__(self, listen: str, *extra_args: str):
         self.proc = subprocess.Popen(
             [sys.executable, os.path.join(REPO, "tools", "bench_server.py"),
-             "--listen", listen],
+             "--listen", listen, *extra_args],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE, cwd=REPO,
             text=True)
         line = self.proc.stdout.readline().strip()
@@ -172,6 +172,93 @@ def bench_tpu_sweep():
         srv.close()
 
 
+def bench_native_lane():
+    """The framework's native lane end to end: C++ bench client (the analog
+    of the reference's C++ client binaries) against the C++ engine serving
+    a registered native echo. QPS phase + payload sweep; returns the 1MB
+    bandwidth (headline when available)."""
+    from brpc_tpu.rpc.native_transport import (bench_echo_native,
+                                               dataplane_available)
+
+    if not dataplane_available():
+        print("# native lane skipped: engine unavailable", file=sys.stderr)
+        return None
+    srv = _BenchServer("127.0.0.1:0", "--native", "--native_echo")
+    headline = None
+    try:
+        host, port = srv.endpoint.rsplit(":", 1)
+        port = int(port)
+        dur = 400 if QUICK else 2000
+        r = bench_echo_native(host, port, conns=16, depth=8, payload=16,
+                              duration_ms=dur)
+        print(f"# native lane multi_conn_echo: conns=16 depth=8 "
+              f"qps={r['qps']:,.0f} p50={r['p50_us']:.0f}us "
+              f"p99={r['p99_us']:.0f}us p999={r['p999_us']:.0f}us",
+              file=sys.stderr)
+        r = bench_echo_native(host, port, conns=1, depth=1, payload=16,
+                              duration_ms=dur)
+        print(f"# native lane ping_pong: qps={r['qps']:,.0f} "
+              f"p50={r['p50_us']:.0f}us p99={r['p99_us']:.0f}us",
+              file=sys.stderr)
+        print("# native lane sweep (C++ client, C++ echo service):",
+              file=sys.stderr)
+        for size, conns, depth in [(64, 8, 4), (4096, 8, 4), (65536, 8, 4),
+                                   (1 << 20, 4, 4), (16 << 20, 2, 4)]:
+            r = bench_echo_native(host, port, conns=conns, depth=depth,
+                                  payload=size, duration_ms=dur)
+            print(f"#   {size:>9}B x{conns}conns x{depth}deep: "
+                  f"{r['gbps']:7.3f} GB/s  qps={r['qps']:9,.0f}  "
+                  f"p50={r['p50_us']/1e3:8.2f}ms "
+                  f"p99={r['p99_us']/1e3:8.2f}ms", file=sys.stderr)
+            if size == HEADLINE_SIZE:
+                headline = r["gbps"]
+        return headline
+    finally:
+        srv.close()
+
+
+def bench_hybrid_native():
+    """Python client/service code over the native engine (the hybrid lane
+    most users run): QPS + 1MB attachment echo."""
+    from brpc_tpu.proto import echo_pb2
+    from brpc_tpu.rpc import Channel, ChannelOptions, Controller, Stub
+    from brpc_tpu.rpc.native_transport import dataplane_available
+
+    if not dataplane_available():
+        return
+    srv = _BenchServer("127.0.0.1:0", "--native")
+    try:
+        ch = Channel(ChannelOptions(protocol="trpc_std", timeout_ms=30000,
+                                    native_transport=True))
+        ch.init(srv.endpoint)
+        stub = Stub(ch, echo_pb2.DESCRIPTOR.services_by_name["EchoService"])
+        _run_calls(stub, echo_pb2, b"w" * 16, 4, 25)  # warmup
+        calls = 40 if QUICK else 400
+        wall, lats = _run_calls(stub, echo_pb2, b"x" * 16, QPS_THREADS, calls)
+        print(f"# hybrid lane (py client+service, native engine): "
+              f"qps={len(lats)/wall:,.0f} "
+              f"p50={_percentile(lats,0.5)*1e6:.0f}us "
+              f"p99={_percentile(lats,0.99)*1e6:.0f}us", file=sys.stderr)
+        # 1MB attachment echo, single thread (GIL makes threads moot here)
+        att = b"\xab" * (1 << 20)
+        lats = []
+        n = 8 if QUICK else 60
+        for _ in range(n):
+            cntl = Controller()
+            cntl.request_attachment = att
+            t0 = time.perf_counter()
+            stub.Echo(echo_pb2.EchoRequest(message="b"), controller=cntl)
+            lats.append(time.perf_counter() - t0)
+            assert len(cntl.response_attachment) == len(att)
+        lats.sort()
+        gbps = 2 * len(att) / lats[len(lats) // 2] / 1e9
+        print(f"# hybrid lane 1MB attachment echo: p50="
+              f"{lats[len(lats)//2]*1e3:.2f}ms ({gbps:.3f} GB/s)",
+              file=sys.stderr)
+    finally:
+        srv.close()
+
+
 def bench_device_probe():
     """On-chip HBM echo ceiling (Pallas copy loop) — stderr diagnostic.
     Marginal-cost slope isolates per-round device time from the tunnel's
@@ -203,12 +290,17 @@ def bench_device_probe():
 
 def main() -> None:
     bench_multi_threaded_echo()
-    headline = bench_tpu_sweep()
+    native_1mb = bench_native_lane()
+    bench_hybrid_native()
+    py_1mb = bench_tpu_sweep()
     if os.environ.get("BENCH_SKIP_DEVICE") != "1" and not QUICK:
         try:
             bench_device_probe()
         except Exception as e:  # diagnostics must never sink the bench
             print(f"# device probe skipped: {e}", file=sys.stderr)
+    # headline: the framework's fastest supported lane (native when built,
+    # like the reference's C++ stack; Python tpu:// sweep otherwise)
+    headline = native_1mb if native_1mb is not None else py_1mb
     print(json.dumps({
         "metric": "echo_1mb_framework_bandwidth",
         "value": round(headline, 3),

@@ -109,6 +109,9 @@ class EndPoint:
     def is_tpu(self) -> bool:
         return self.kind == "tpu"
 
+    def is_unix(self) -> bool:
+        return self.kind == "unix"
+
     def sockaddr(self):
         """(family, address) usable with the socket module (ip/unix only)."""
         if self.kind == "ip":

@@ -19,10 +19,14 @@ from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "core.cpp")
+_DP_SRC = os.path.join(_DIR, "dataplane.cpp")
 
 _lib = None
 _lib_lock = threading.Lock()
 _build_error: Optional[str] = None
+_dp_lib = None
+_dp_lock = threading.Lock()
+_dp_build_error: Optional[str] = None
 
 
 def _build_flags():
@@ -34,10 +38,17 @@ def _build_flags():
     return flags
 
 
-def _so_path() -> str:
-    with open(_SRC, "rb") as f:
+def _build_so(src: str, stem: str, extra_flags=()) -> str:
+    """Compile src to a digest-named .so next to it; raises on failure."""
+    with open(src, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    return os.path.join(_DIR, f"_core_{digest}.so")
+    so = os.path.join(_DIR, f"_{stem}_{digest}.so")
+    if not os.path.exists(so):
+        tmp = f"{so}.{os.getpid()}.tmp"
+        cmd = ["g++", *_build_flags(), *extra_flags, src, "-o", tmp]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+        os.replace(tmp, so)
+    return so
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -48,21 +59,11 @@ def load() -> Optional[ctypes.CDLL]:
             return _lib
         if _build_error is not None:
             return None
-        so = _so_path()
-        if not os.path.exists(so):
-            tmp = so + ".tmp"
-            cmd = ["g++", *_build_flags(), _SRC, "-o", tmp]
-            try:
-                subprocess.run(cmd, check=True, capture_output=True,
-                               timeout=120)
-                os.replace(tmp, so)
-            except (OSError, subprocess.SubprocessError) as e:
-                _build_error = f"{type(e).__name__}: {e}"
-                return None
         try:
+            so = _build_so(_SRC, "core")
             lib = ctypes.CDLL(so)
-        except OSError as e:
-            _build_error = str(e)
+        except (OSError, subprocess.SubprocessError) as e:
+            _build_error = f"{type(e).__name__}: {e}"
             return None
         lib.tn_crc32c.restype = ctypes.c_uint32
         lib.tn_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
@@ -87,6 +88,88 @@ def load() -> Optional[ctypes.CDLL]:
 
 def build_error() -> Optional[str]:
     return _build_error
+
+
+# ---------------------------------------------------------------- dataplane
+class DpEventStruct(ctypes.Structure):
+    """Mirror of DpEvent in dataplane.cpp."""
+
+    _fields_ = [
+        ("kind", ctypes.c_int32),
+        ("tag", ctypes.c_int32),
+        ("conn_id", ctypes.c_uint64),
+        ("aux", ctypes.c_int64),
+        ("base", ctypes.c_void_p),
+        ("meta", ctypes.c_void_p),
+        ("meta_len", ctypes.c_uint64),
+        ("body", ctypes.c_void_p),
+        ("body_len", ctypes.c_uint64),
+    ]
+
+
+def load_dataplane() -> Optional[ctypes.CDLL]:
+    """Build (if needed) and load the dataplane engine; None on failure."""
+    global _dp_lib, _dp_build_error
+    with _dp_lock:
+        if _dp_lib is not None:
+            return _dp_lib
+        if _dp_build_error is not None:
+            return None
+        try:
+            so = _build_so(_DP_SRC, "dataplane", ("-pthread",))
+            lib = ctypes.CDLL(so)
+        except (OSError, subprocess.SubprocessError) as e:
+            _dp_build_error = f"{type(e).__name__}: {e}"
+            return None
+        ev_p = ctypes.POINTER(DpEventStruct)
+        lib.dp_abi_version.restype = ctypes.c_int
+        lib.dp_rt_create.restype = ctypes.c_void_p
+        lib.dp_rt_create.argtypes = [ctypes.c_int, ctypes.c_uint64]
+        lib.dp_rt_shutdown.argtypes = [ctypes.c_void_p]
+        lib.dp_listen.restype = ctypes.c_int
+        lib.dp_listen.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int]
+        lib.dp_listener_close.restype = ctypes.c_int
+        lib.dp_listener_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.dp_listen_port.restype = ctypes.c_int
+        lib.dp_listen_port.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.dp_register_echo.restype = ctypes.c_int
+        lib.dp_register_echo.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_char_p]
+        lib.dp_connect.restype = ctypes.c_uint64
+        lib.dp_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int, ctypes.c_int,
+                                   ctypes.POINTER(ctypes.c_int)]
+        lib.dp_send.restype = ctypes.c_int
+        lib.dp_send.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                ctypes.c_char_p, ctypes.c_uint64]
+        lib.dp_sendv.restype = ctypes.c_int
+        lib.dp_sendv.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                 ctypes.POINTER(ctypes.c_char_p),
+                                 ctypes.POINTER(ctypes.c_uint64),
+                                 ctypes.c_int]
+        lib.dp_poll.restype = ctypes.c_int
+        lib.dp_poll.argtypes = [ctypes.c_void_p, ev_p, ctypes.c_int,
+                                ctypes.c_int]
+        lib.dp_free.argtypes = [ctypes.c_void_p]
+        lib.dp_conn_close.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.dp_conn_stats.restype = ctypes.c_int
+        lib.dp_conn_stats.argtypes = [ctypes.c_void_p, ctypes.c_uint64] + \
+            [ctypes.POINTER(ctypes.c_uint64)] * 4
+        lib.dp_bench_echo.restype = ctypes.c_int
+        lib.dp_bench_echo.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+        ] + [ctypes.POINTER(ctypes.c_double)] * 5
+        if lib.dp_abi_version() != 1:
+            _dp_build_error = "dataplane abi mismatch"
+            return None
+        _dp_lib = lib
+        return _dp_lib
+
+
+def dataplane_build_error() -> Optional[str]:
+    return _dp_build_error
 
 
 # ------------------------------------------------------------- installation

@@ -1,0 +1,1220 @@
+// dataplane — the native transport core (SURVEY §7: "C++ ... must be native
+// to hit latency targets"; reference socket.cpp / event_dispatcher_epoll.cpp
+// / input_messenger.cpp are the blueprint, re-designed for a hybrid
+// C++-engine + Python-policy stack).
+//
+// What runs here, GIL-free, on dedicated event-loop threads:
+//   - epoll event loops (reference EventDispatcher::Run,
+//     event_dispatcher_epoll.cpp:196-206), one epoll per loop thread,
+//     connections spread round-robin (event_dispatcher_num analog)
+//   - nonblocking sockets with claimed-writer inline send + queued drain on
+//     EPOLLOUT (reference Socket::StartWrite/KeepWrite, socket.cpp:1692)
+//   - TRPC/TSTR frame cutting straight off the read buffer (reference
+//     InputMessenger::CutInputMessage, input_messenger.cpp:84)
+//   - native services: registered (service, method) pairs answered entirely
+//     in C++ (the reference's user code IS C++; echo is the built-in one)
+//   - a minimal protobuf wire reader/writer for RpcMeta — just the fields
+//     the fast path needs (proto/rpc_meta.proto layout)
+//
+// Everything else — protocol policy, retries, auth, limiters, user Python
+// services — stays in Python: complete frames are handed up through a
+// poll()-based event queue (one malloc per message, batch retrieval), and
+// Python hands packed response/request packets back through dp_send.
+// Connections that speak anything other than the TRPC frame family are
+// DETACHED: removed from the native epoll and surfaced with their fd and
+// buffered bytes so the Python stack (http dashboard, grpc, redis ...)
+// takes over that connection transparently.
+//
+// No dependencies beyond libc/pthread. C ABI only (ctypes loads it).
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <time.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- constants
+constexpr uint32_t kHeaderSize = 12;
+constexpr uint64_t kDefaultMaxBody = 512ull << 20;
+constexpr uint64_t kWriteQueueMax = 64ull << 20;   // EOVERCROWDED beyond
+constexpr uint64_t kEventQueueMaxBytes = 512ull << 20;
+constexpr size_t kReadChunk = 256 * 1024;
+
+// event kinds (Python mirror in rpc/native_transport.py)
+enum {
+  EV_FRAME = 1,     // tag: 0 TRPC / 1 TSTR; meta+body buffers
+  EV_FAILED = 2,    // tag: error class; meta: reason text
+  EV_ACCEPTED = 3,  // aux: listener id; meta: "host:port" of peer
+  EV_DETACHED = 4,  // aux: fd (now owned by consumer); meta: buffered bytes
+};
+
+// error classes for EV_FAILED.tag / dp_send return (Python maps to errors.py)
+enum {
+  DPE_OK = 0,
+  DPE_EOF = 1,         // clean close by peer
+  DPE_IO = 2,          // errno-style failure
+  DPE_PROTOCOL = 3,    // bad frame
+  DPE_OVERCROWDED = 4, // write queue limit
+  DPE_NOTFOUND = 5,    // unknown conn id
+};
+
+struct DpEvent {
+  int32_t kind;
+  int32_t tag;
+  uint64_t conn_id;
+  int64_t aux;
+  void* base;  // single free() handle for meta+body
+  void* meta;
+  uint64_t meta_len;
+  void* body;
+  uint64_t body_len;
+};
+
+// ------------------------------------------------------------ pb wire codec
+// Minimal protobuf reader for RpcMeta / RequestMeta (proto/rpc_meta.proto).
+bool pb_varint(const uint8_t*& p, const uint8_t* end, uint64_t* v) {
+  uint64_t r = 0;
+  int shift = 0;
+  while (p < end && shift < 64) {
+    uint8_t b = *p++;
+    r |= uint64_t(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *v = r;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+bool pb_skip(const uint8_t*& p, const uint8_t* end, uint32_t wire_type) {
+  uint64_t tmp;
+  switch (wire_type) {
+    case 0:
+      return pb_varint(p, end, &tmp);
+    case 1:
+      if (end - p < 8) return false;
+      p += 8;
+      return true;
+    case 2:
+      if (!pb_varint(p, end, &tmp) || uint64_t(end - p) < tmp) return false;
+      p += tmp;
+      return true;
+    case 5:
+      if (end - p < 4) return false;
+      p += 4;
+      return true;
+    default:
+      return false;
+  }
+}
+
+void pb_put_varint(std::string* out, uint64_t v) {
+  while (v >= 0x80) {
+    out->push_back(char(v | 0x80));
+    v >>= 7;
+  }
+  out->push_back(char(v));
+}
+
+void pb_put_tag(std::string* out, uint32_t field, uint32_t wt) {
+  pb_put_varint(out, (field << 3) | wt);
+}
+
+// Parsed just-enough RpcMeta for routing + the native fast path.
+struct MetaLite {
+  bool has_request = false;
+  bool has_response = false;
+  bool has_stream_settings = false;
+  bool has_auth = false;
+  uint64_t correlation_id = 0;
+  uint64_t attempt_version = 0;
+  uint64_t compress_type = 0;
+  uint64_t attachment_size = 0;
+  uint64_t checksum = 0;
+  std::string service;
+  std::string method;
+};
+
+bool parse_request_meta(const uint8_t* p, const uint8_t* end, MetaLite* m) {
+  while (p < end) {
+    uint64_t key;
+    if (!pb_varint(p, end, &key)) return false;
+    uint32_t field = key >> 3, wt = key & 7;
+    if (field == 1 && wt == 2) {
+      uint64_t len;
+      if (!pb_varint(p, end, &len) || uint64_t(end - p) < len) return false;
+      m->service.assign(reinterpret_cast<const char*>(p), len);
+      p += len;
+    } else if (field == 2 && wt == 2) {
+      uint64_t len;
+      if (!pb_varint(p, end, &len) || uint64_t(end - p) < len) return false;
+      m->method.assign(reinterpret_cast<const char*>(p), len);
+      p += len;
+    } else if (!pb_skip(p, end, wt)) {
+      return false;
+    }
+  }
+  return true;
+}
+
+bool parse_meta_lite(const uint8_t* p, const uint8_t* end, MetaLite* m) {
+  while (p < end) {
+    uint64_t key;
+    if (!pb_varint(p, end, &key)) return false;
+    uint32_t field = key >> 3, wt = key & 7;
+    uint64_t v;
+    switch (field) {
+      case 1:  // RequestMeta
+        if (wt != 2) return false;
+        if (!pb_varint(p, end, &v) || uint64_t(end - p) < v) return false;
+        m->has_request = true;
+        if (!parse_request_meta(p, p + v, m)) return false;
+        p += v;
+        break;
+      case 2:  // ResponseMeta
+        m->has_response = true;
+        if (!pb_skip(p, end, wt)) return false;
+        break;
+      case 3:
+        if (!pb_varint(p, end, &m->correlation_id)) return false;
+        break;
+      case 4:
+        if (!pb_varint(p, end, &m->attempt_version)) return false;
+        break;
+      case 5:
+        if (!pb_varint(p, end, &m->compress_type)) return false;
+        break;
+      case 6:
+        if (!pb_varint(p, end, &m->attachment_size)) return false;
+        break;
+      case 7:
+        if (!pb_varint(p, end, &m->checksum)) return false;
+        break;
+      case 8:
+        m->has_stream_settings = true;
+        if (!pb_skip(p, end, wt)) return false;
+        break;
+      case 9:
+        m->has_auth = true;
+        if (!pb_skip(p, end, wt)) return false;
+        break;
+      default:
+        if (!pb_skip(p, end, wt)) return false;
+    }
+  }
+  return true;
+}
+
+// RpcMeta for a native fast-path response:
+//   response{} (empty = OK), correlation_id, attempt_version,
+//   attachment_size — mirroring server_processing._send_response.
+std::string build_echo_response_meta(const MetaLite& req) {
+  std::string meta;
+  pb_put_tag(&meta, 2, 2);  // response submessage, present-but-empty = OK
+  pb_put_varint(&meta, 0);
+  if (req.correlation_id) {
+    pb_put_tag(&meta, 3, 0);
+    pb_put_varint(&meta, req.correlation_id);
+  }
+  if (req.attempt_version) {
+    pb_put_tag(&meta, 4, 0);
+    pb_put_varint(&meta, req.attempt_version);
+  }
+  if (req.attachment_size) {
+    pb_put_tag(&meta, 6, 0);
+    pb_put_varint(&meta, req.attachment_size);
+  }
+  return meta;
+}
+
+// --------------------------------------------------------------- data types
+struct Runtime;
+
+struct RBuf {
+  uint8_t* data = nullptr;
+  size_t cap = 0;
+  size_t size = 0;
+  ~RBuf() { free(data); }
+  uint8_t* tail(size_t need) {
+    if (size + need > cap) {
+      size_t ncap = cap ? cap * 2 : (64 << 10);
+      while (ncap < size + need) ncap *= 2;
+      data = static_cast<uint8_t*>(realloc(data, ncap));
+      cap = ncap;
+    }
+    return data + size;
+  }
+};
+
+struct Conn {
+  uint64_t id = 0;
+  int fd = -1;
+  int loop = 0;
+  bool is_server = false;
+  std::atomic<bool> failed{false};
+  bool detached = false;
+
+  // read side (loop thread only)
+  RBuf rbuf;
+  size_t rpos = 0;
+
+  // write side (any thread; wmu guards)
+  std::mutex wmu;
+  std::deque<std::string> wq;
+  size_t wq_off = 0;  // offset into wq.front()
+  uint64_t wq_bytes = 0;
+  bool want_write = false;
+
+  std::atomic<uint64_t> in_bytes{0}, out_bytes{0};
+  std::atomic<uint64_t> in_msgs{0}, out_msgs{0};
+};
+
+struct Listener {
+  int fd = -1;
+  int port = 0;
+};
+
+struct Loop {
+  int epfd = -1;
+  int evfd = -1;  // eventfd wakeup for the task queue
+  std::thread thr;
+  std::mutex tmu;
+  std::vector<std::function<void()>> tasks;
+};
+
+struct Runtime {
+  std::vector<std::unique_ptr<Loop>> loops;
+  std::atomic<bool> running{true};
+  uint64_t max_body = kDefaultMaxBody;
+
+  std::mutex cmu;  // conns + listeners
+  std::unordered_map<uint64_t, std::shared_ptr<Conn>> conns;
+  std::vector<Listener> listeners;
+  std::atomic<uint64_t> next_conn_id{1};
+  std::atomic<int> rr{0};
+
+  std::mutex emu;
+  std::condition_variable ecv;
+  std::deque<DpEvent> events;
+  uint64_t event_bytes = 0;
+
+  std::mutex rmu;  // native service registry
+  std::vector<std::pair<std::string, std::string>> echo_services;
+};
+
+// ------------------------------------------------------------------ helpers
+void push_event(Runtime* rt, DpEvent ev) {
+  std::unique_lock<std::mutex> lk(rt->emu);
+  rt->event_bytes += ev.meta_len + ev.body_len + sizeof(DpEvent);
+  // soft cap: beyond it the loop threads stall here — natural backpressure
+  // (the consumer is the Python poller; it drains in batches)
+  while (rt->running.load() && rt->event_bytes > kEventQueueMaxBytes &&
+         rt->events.size() > 16) {
+    lk.unlock();
+    usleep(1000);
+    lk.lock();
+  }
+  rt->events.push_back(ev);
+  rt->ecv.notify_one();
+}
+
+void emit_failed(Runtime* rt, Conn* c, int err_class, const char* reason) {
+  size_t rl = strlen(reason);
+  char* buf = static_cast<char*>(malloc(rl ? rl : 1));
+  memcpy(buf, reason, rl);
+  DpEvent ev{};
+  ev.kind = EV_FAILED;
+  ev.tag = err_class;
+  ev.conn_id = c->id;
+  ev.base = buf;
+  ev.meta = buf;
+  ev.meta_len = rl;
+  push_event(rt, ev);
+}
+
+void loop_submit(Runtime* rt, int li, std::function<void()> fn) {
+  Loop* l = rt->loops[li].get();
+  {
+    std::lock_guard<std::mutex> lk(l->tmu);
+    l->tasks.push_back(std::move(fn));
+  }
+  uint64_t one = 1;
+  ssize_t r = write(l->evfd, &one, 8);
+  (void)r;
+}
+
+// epoll re-arm helper. Loop-thread-only for IN; OUT armed from writers too
+// (epoll_ctl is thread-safe).
+void arm(Runtime* rt, Conn* c, bool out) {
+  epoll_event ev{};
+  ev.events = out ? (EPOLLIN | EPOLLOUT) : EPOLLIN;
+  ev.data.u64 = c->id;
+  epoll_ctl(rt->loops[c->loop]->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+// Fail a connection: unregister, close, emit event, drop from table.
+// Runs on the owning loop thread (writers route through loop_submit).
+void conn_fail(Runtime* rt, const std::shared_ptr<Conn>& c, int err_class,
+               const char* reason) {
+  bool expected = false;
+  if (!c->failed.compare_exchange_strong(expected, true)) return;
+  {
+    // exclude in-flight writers before closing: a writev racing the close
+    // could otherwise land on a recycled fd of a brand-new connection
+    std::lock_guard<std::mutex> wlk(c->wmu);
+    epoll_ctl(rt->loops[c->loop]->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+    close(c->fd);
+    c->fd = -1;
+  }
+  emit_failed(rt, c.get(), err_class, reason);
+  std::lock_guard<std::mutex> lk(rt->cmu);
+  rt->conns.erase(c->id);
+}
+
+// ----------------------------------------------------------------- writing
+// dp_send core: claimed-writer inline vectored send, queue remainder, arm
+// EPOLLOUT (reference Socket::StartWrite, socket.cpp:1692-1800). One packet
+// = n segments (header/meta/payload/attachment refs from the IOBuf chain);
+// the common case finishes in one writev with ZERO assembly copies.
+int conn_writev(Runtime* rt, const std::shared_ptr<Conn>& c,
+                const uint8_t* const* bufs, const uint64_t* lens, int nseg) {
+  uint64_t len = 0;
+  for (int i = 0; i < nseg; i++) len += lens[i];
+  if (c->failed.load()) return DPE_IO;
+  std::lock_guard<std::mutex> lk(c->wmu);
+  if (c->failed.load() || c->fd < 0) return DPE_IO;
+  if (c->wq_bytes + len > kWriteQueueMax) return DPE_OVERCROWDED;
+  uint64_t off = 0;  // bytes of the packet already on the wire
+  if (c->wq.empty()) {
+    iovec iov[64];
+    while (off < len) {
+      // rebuild the iov for the unwritten tail
+      uint64_t skip = off;
+      int iv = 0;
+      for (int i = 0; i < nseg && iv < 64; i++) {
+        if (skip >= lens[i]) {
+          skip -= lens[i];
+          continue;
+        }
+        iov[iv].iov_base = const_cast<uint8_t*>(bufs[i]) + skip;
+        iov[iv].iov_len = size_t(lens[i] - skip);
+        skip = 0;
+        iv++;
+      }
+      ssize_t n = ::writev(c->fd, iov, iv);
+      if (n > 0) {
+        off += uint64_t(n);
+      } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        break;
+      } else if (n < 0 && errno == EINTR) {
+        continue;
+      } else {
+        // hard error: the loop will observe it too; report now
+        return DPE_IO;
+      }
+    }
+    c->out_bytes.fetch_add(off, std::memory_order_relaxed);
+  }
+  if (off < len) {
+    // assemble only the unwritten remainder
+    std::string rest;
+    rest.reserve(size_t(len - off));
+    uint64_t skip = off;
+    for (int i = 0; i < nseg; i++) {
+      if (skip >= lens[i]) {
+        skip -= lens[i];
+        continue;
+      }
+      rest.append(reinterpret_cast<const char*>(bufs[i]) + skip,
+                  size_t(lens[i] - skip));
+      skip = 0;
+    }
+    c->wq_bytes += rest.size();
+    c->wq.push_back(std::move(rest));
+    if (!c->want_write) {
+      c->want_write = true;
+      arm(rt, c.get(), true);
+    }
+  }
+  c->out_msgs.fetch_add(1, std::memory_order_relaxed);
+  return DPE_OK;
+}
+
+int conn_write(Runtime* rt, const std::shared_ptr<Conn>& c,
+               const uint8_t* data, uint64_t len) {
+  const uint8_t* bufs[1] = {data};
+  const uint64_t lens[1] = {len};
+  return conn_writev(rt, c, bufs, lens, 1);
+}
+
+// EPOLLOUT drain on the loop thread (KeepWrite analog).
+void conn_drain_writes(Runtime* rt, const std::shared_ptr<Conn>& c) {
+  std::lock_guard<std::mutex> lk(c->wmu);
+  if (c->failed.load() || c->fd < 0) return;
+  while (!c->wq.empty()) {
+    std::string& front = c->wq.front();
+    size_t left = front.size() - c->wq_off;
+    ssize_t n = ::send(c->fd, front.data() + c->wq_off, left, MSG_NOSIGNAL);
+    if (n > 0) {
+      c->out_bytes.fetch_add(uint64_t(n), std::memory_order_relaxed);
+      c->wq_bytes -= uint64_t(n);
+      c->wq_off += size_t(n);
+      if (c->wq_off == front.size()) {
+        c->wq.pop_front();
+        c->wq_off = 0;
+      }
+    } else if (n < 0 && errno == EINTR) {
+      continue;
+    } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      return;  // stay armed
+    } else {
+      c->want_write = false;
+      // fail from the loop thread after the lock is released
+      loop_submit(rt, c->loop, [rt, c] { conn_fail(rt, c, DPE_IO, "send"); });
+      return;
+    }
+  }
+  c->want_write = false;
+  arm(rt, c.get(), false);
+}
+
+// ----------------------------------------------------------------- parsing
+bool echo_match(Runtime* rt, const MetaLite& m) {
+  std::lock_guard<std::mutex> lk(rt->rmu);
+  for (auto& sm : rt->echo_services) {
+    if (sm.first == m.service && sm.second == m.method) return true;
+  }
+  return false;
+}
+
+// Answer a registered echo request natively: header + rebuilt meta + body
+// copied straight into the write path. Returns false if the frame should
+// go to Python instead.
+bool try_native_echo(Runtime* rt, const std::shared_ptr<Conn>& c,
+                     const MetaLite& m, const uint8_t* body,
+                     uint64_t body_len) {
+  if (!c->is_server || !m.has_request || m.has_response || m.compress_type ||
+      m.checksum || m.has_stream_settings || m.has_auth) {
+    return false;
+  }
+  if (m.attachment_size > body_len) return false;
+  if (!echo_match(rt, m)) return false;
+  std::string head;
+  {
+    std::string meta = build_echo_response_meta(m);
+    head.reserve(kHeaderSize + meta.size());
+    head.append("TRPC", 4);
+    uint32_t ms = htonl(uint32_t(meta.size()));
+    uint32_t bs = htonl(uint32_t(body_len));
+    head.append(reinterpret_cast<char*>(&ms), 4);
+    head.append(reinterpret_cast<char*>(&bs), 4);
+    head.append(meta);
+  }
+  // body still points into the conn's read buffer: conn_writev either puts
+  // it on the wire or copies the remainder before returning, so the
+  // zero-assembly reference is safe
+  const uint8_t* bufs[2] = {reinterpret_cast<const uint8_t*>(head.data()),
+                            body};
+  const uint64_t lens[2] = {head.size(), body_len};
+  int rc = conn_writev(rt, c, bufs, lens, 2);
+  if (rc != DPE_OK) {
+    // a consumed request whose response can't be queued leaves the client
+    // hanging — the stream contract is broken, tear the conn down
+    loop_submit(rt, c->loop, [rt, c, rc] {
+      conn_fail(rt, c, rc == DPE_OVERCROWDED ? DPE_OVERCROWDED : DPE_IO,
+                "native echo response undeliverable");
+    });
+  }
+  return true;
+}
+
+void deliver_frame(Runtime* rt, Conn* c, int tag, const uint8_t* meta,
+                   uint64_t meta_len, const uint8_t* body, uint64_t body_len) {
+  uint8_t* blk = static_cast<uint8_t*>(malloc(meta_len + body_len + 1));
+  memcpy(blk, meta, meta_len);
+  memcpy(blk + meta_len, body, body_len);
+  DpEvent ev{};
+  ev.kind = EV_FRAME;
+  ev.tag = tag;
+  ev.conn_id = c->id;
+  ev.base = blk;
+  ev.meta = blk;
+  ev.meta_len = meta_len;
+  ev.body = blk + meta_len;
+  ev.body_len = body_len;
+  push_event(rt, ev);
+}
+
+// Detach: hand the fd + buffered bytes to Python (non-TRPC protocol on a
+// native port — http dashboard, grpc, redis... take over seamlessly).
+void conn_detach(Runtime* rt, const std::shared_ptr<Conn>& c) {
+  bool expected = false;
+  if (!c->failed.compare_exchange_strong(expected, true)) return;
+  int fd;
+  {
+    std::lock_guard<std::mutex> wlk(c->wmu);
+    c->detached = true;
+    epoll_ctl(rt->loops[c->loop]->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+    fd = c->fd;
+    c->fd = -1;  // ownership transfers to the consumer via the event
+  }
+  size_t left = c->rbuf.size - c->rpos;
+  uint8_t* blk = static_cast<uint8_t*>(malloc(left ? left : 1));
+  memcpy(blk, c->rbuf.data + c->rpos, left);
+  DpEvent ev{};
+  ev.kind = EV_DETACHED;
+  ev.tag = 0;
+  ev.conn_id = c->id;
+  ev.aux = fd;
+  ev.base = blk;
+  ev.meta = blk;
+  ev.meta_len = left;
+  push_event(rt, ev);
+  std::lock_guard<std::mutex> lk(rt->cmu);
+  rt->conns.erase(c->id);
+}
+
+// Cut complete frames out of c->rbuf (loop thread only).
+void conn_parse(Runtime* rt, const std::shared_ptr<Conn>& c) {
+  RBuf& buf = c->rbuf;
+  for (;;) {
+    size_t avail = buf.size - c->rpos;
+    if (avail < kHeaderSize) break;
+    const uint8_t* p = buf.data + c->rpos;
+    bool is_trpc = memcmp(p, "TRPC", 4) == 0;
+    bool is_tstr = !is_trpc && memcmp(p, "TSTR", 4) == 0;
+    if (!is_trpc && !is_tstr) {
+      conn_detach(rt, c);
+      return;
+    }
+    uint32_t meta_size = ntohl(*reinterpret_cast<const uint32_t*>(p + 4));
+    uint32_t body_size = ntohl(*reinterpret_cast<const uint32_t*>(p + 8));
+    uint64_t total = uint64_t(meta_size) + body_size;
+    if (total > rt->max_body) {
+      conn_fail(rt, c, DPE_PROTOCOL, "frame exceeds max_body");
+      return;
+    }
+    if (avail < kHeaderSize + total) break;
+    const uint8_t* meta = p + kHeaderSize;
+    const uint8_t* body = meta + meta_size;
+    c->in_msgs.fetch_add(1, std::memory_order_relaxed);
+    bool handled = false;
+    if (is_trpc) {
+      MetaLite m;
+      if (parse_meta_lite(meta, meta + meta_size, &m)) {
+        handled = try_native_echo(rt, c, m, body, body_size);
+      } else {
+        conn_fail(rt, c, DPE_PROTOCOL, "bad RpcMeta");
+        return;
+      }
+    }
+    if (!handled) {
+      deliver_frame(rt, c.get(), is_tstr ? 1 : 0, meta, meta_size, body,
+                    body_size);
+    }
+    c->rpos += kHeaderSize + total;
+  }
+  // compact
+  if (c->rpos == buf.size) {
+    buf.size = 0;
+    c->rpos = 0;
+  } else if (c->rpos > (1 << 20)) {
+    memmove(buf.data, buf.data + c->rpos, buf.size - c->rpos);
+    buf.size -= c->rpos;
+    c->rpos = 0;
+  }
+}
+
+void conn_readable(Runtime* rt, const std::shared_ptr<Conn>& c) {
+  for (;;) {
+    // when mid-frame, read the whole remainder in one recv
+    size_t want = kReadChunk;
+    size_t avail = c->rbuf.size - c->rpos;
+    if (avail >= kHeaderSize) {
+      const uint8_t* p = c->rbuf.data + c->rpos;
+      if (!memcmp(p, "TRPC", 4) || !memcmp(p, "TSTR", 4)) {
+        uint64_t total = kHeaderSize +
+            uint64_t(ntohl(*reinterpret_cast<const uint32_t*>(p + 4))) +
+            uint64_t(ntohl(*reinterpret_cast<const uint32_t*>(p + 8)));
+        if (total > avail && total - avail > want &&
+            total <= rt->max_body + kHeaderSize) {
+          want = total - avail;
+        }
+      }
+    }
+    uint8_t* dst = c->rbuf.tail(want);
+    ssize_t n = ::recv(c->fd, dst, want, 0);
+    if (n > 0) {
+      c->rbuf.size += size_t(n);
+      c->in_bytes.fetch_add(uint64_t(n), std::memory_order_relaxed);
+      conn_parse(rt, c);
+      if (c->failed.load()) return;
+      if (size_t(n) < want) return;  // drained
+    } else if (n == 0) {
+      conn_parse(rt, c);
+      if (!c->failed.load()) conn_fail(rt, c, DPE_EOF, "peer closed");
+      return;
+    } else if (errno == EINTR) {
+      continue;
+    } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      return;
+    } else {
+      conn_fail(rt, c, DPE_IO, strerror(errno));
+      return;
+    }
+  }
+}
+
+// ------------------------------------------------------------ registration
+std::shared_ptr<Conn> create_conn(Runtime* rt, int fd, bool is_server) {
+  auto c = std::make_shared<Conn>();
+  c->id = rt->next_conn_id.fetch_add(1);
+  c->fd = fd;
+  c->is_server = is_server;
+  c->loop = rt->rr.fetch_add(1) % int(rt->loops.size());
+  std::lock_guard<std::mutex> lk(rt->cmu);
+  rt->conns[c->id] = c;
+  return c;
+}
+
+// Arm the conn's fd in its loop's epoll. Must run AFTER any bookkeeping
+// whose events must precede the conn's first frame (ACCEPTED ordering).
+void activate_conn(Runtime* rt, const std::shared_ptr<Conn>& c) {
+  loop_submit(rt, c->loop, [rt, c] {
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = c->id;
+    if (epoll_ctl(rt->loops[c->loop]->epfd, EPOLL_CTL_ADD, c->fd, &ev) != 0) {
+      conn_fail(rt, c, DPE_IO, "epoll add");
+    }
+  });
+}
+
+void accept_ready(Runtime* rt, int lid) {
+  int lfd = -1;
+  {
+    // dp_listen may grow the vector and dp_listener_close retire the fd
+    // concurrently — snapshot under the lock
+    std::lock_guard<std::mutex> lk(rt->cmu);
+    if (lid < 0 || size_t(lid) >= rt->listeners.size()) return;
+    lfd = rt->listeners[size_t(lid)].fd;
+  }
+  if (lfd < 0) return;
+  for (;;) {
+    sockaddr_storage ss{};
+    socklen_t slen = sizeof(ss);
+    int fd = accept4(lfd, reinterpret_cast<sockaddr*>(&ss), &slen,
+                     SOCK_NONBLOCK | SOCK_CLOEXEC);
+    if (fd < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) return;
+      return;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    int bufsz = 4 << 20;  // deep buffers keep MB-scale echoes streaming
+    setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bufsz, sizeof(bufsz));
+    setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
+    auto c = create_conn(rt, fd, /*is_server=*/true);
+    char host[NI_MAXHOST] = "?", serv[NI_MAXSERV] = "0";
+    getnameinfo(reinterpret_cast<sockaddr*>(&ss), slen, host, sizeof(host),
+                serv, sizeof(serv), NI_NUMERICHOST | NI_NUMERICSERV);
+    std::string peer = std::string(host) + ":" + serv;
+    char* blk = static_cast<char*>(malloc(peer.size() + 1));
+    memcpy(blk, peer.data(), peer.size());
+    DpEvent ev{};
+    ev.kind = EV_ACCEPTED;
+    ev.conn_id = c->id;
+    ev.aux = lid;
+    ev.base = blk;
+    ev.meta = blk;
+    ev.meta_len = peer.size();
+    push_event(rt, ev);         // ACCEPTED strictly precedes the conn's frames
+    activate_conn(rt, c);
+  }
+}
+
+// -------------------------------------------------------------- loop body
+// epoll data encoding: conn events carry the conn id; listener i is encoded
+// as (1<<63)|i; the eventfd as ~0.
+constexpr uint64_t kListenerBit = 1ull << 63;
+constexpr uint64_t kEventFdKey = ~0ull;
+
+void loop_run(Runtime* rt, int li) {
+  Loop* l = rt->loops[li].get();
+  std::vector<epoll_event> evs(256);
+  while (rt->running.load()) {
+    int n = epoll_wait(l->epfd, evs.data(), int(evs.size()), 100);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    for (int i = 0; i < n; i++) {
+      uint64_t key = evs[i].data.u64;
+      if (key == kEventFdKey) {
+        uint64_t drain;
+        ssize_t r = read(l->evfd, &drain, 8);
+        (void)r;
+        std::vector<std::function<void()>> tasks;
+        {
+          std::lock_guard<std::mutex> lk(l->tmu);
+          tasks.swap(l->tasks);
+        }
+        for (auto& t : tasks) t();
+        continue;
+      }
+      if (key & kListenerBit) {
+        accept_ready(rt, int(key & ~kListenerBit));
+        continue;
+      }
+      std::shared_ptr<Conn> c;
+      {
+        std::lock_guard<std::mutex> lk(rt->cmu);
+        auto it = rt->conns.find(key);
+        if (it != rt->conns.end()) c = it->second;
+      }
+      if (!c || c->failed.load()) continue;
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        // let the read path surface the exact error/EOF
+        conn_readable(rt, c);
+        continue;
+      }
+      if (evs[i].events & EPOLLOUT) conn_drain_writes(rt, c);
+      if (c->failed.load()) continue;
+      if (evs[i].events & EPOLLIN) conn_readable(rt, c);
+    }
+  }
+}
+
+}  // namespace
+
+// ===================================================================== ABI
+extern "C" {
+
+int dp_abi_version() { return 1; }
+
+void* dp_rt_create(int nloops, uint64_t max_body) {
+  if (nloops <= 0) nloops = 2;
+  auto* rt = new Runtime();
+  if (max_body) rt->max_body = max_body;
+  for (int i = 0; i < nloops; i++) {
+    auto loop = std::make_unique<Loop>();
+    loop->epfd = epoll_create1(EPOLL_CLOEXEC);
+    loop->evfd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = kEventFdKey;
+    epoll_ctl(loop->epfd, EPOLL_CTL_ADD, loop->evfd, &ev);
+    rt->loops.push_back(std::move(loop));
+  }
+  for (int i = 0; i < nloops; i++) {
+    rt->loops[size_t(i)]->thr = std::thread(loop_run, rt, i);
+  }
+  return rt;
+}
+
+void dp_rt_shutdown(void* h) {
+  auto* rt = static_cast<Runtime*>(h);
+  rt->running.store(false);
+  for (auto& l : rt->loops) {
+    uint64_t one = 1;
+    ssize_t r = write(l->evfd, &one, 8);
+    (void)r;
+  }
+  for (auto& l : rt->loops) {
+    if (l->thr.joinable()) l->thr.join();
+  }
+  {
+    std::lock_guard<std::mutex> lk(rt->cmu);
+    for (auto& kv : rt->conns) {
+      if (kv.second->fd >= 0) close(kv.second->fd);
+    }
+    rt->conns.clear();
+    for (auto& l : rt->listeners) {
+      if (l.fd >= 0) close(l.fd);
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lk(rt->emu);
+    for (auto& ev : rt->events) free(ev.base);
+    rt->events.clear();
+    rt->ecv.notify_all();
+  }
+  for (auto& l : rt->loops) {
+    close(l->epfd);
+    close(l->evfd);
+  }
+  delete rt;
+}
+
+// Returns listener id >= 0, or -errno.
+int dp_listen(void* h, const char* host, int port) {
+  auto* rt = static_cast<Runtime*>(h);
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+  if (fd < 0) return -errno;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(fd);
+    return -EINVAL;
+  }
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 1024) != 0) {
+    int e = errno;
+    close(fd);
+    return -e;
+  }
+  sockaddr_in bound{};
+  socklen_t blen = sizeof(bound);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&bound), &blen);
+  int lid;
+  {
+    std::lock_guard<std::mutex> lk(rt->cmu);
+    lid = int(rt->listeners.size());
+    rt->listeners.push_back({fd, ntohs(bound.sin_port)});
+  }
+  // all listeners live on loop 0 (accepted conns spread round-robin)
+  loop_submit(rt, 0, [rt, fd, lid] {
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = kListenerBit | uint64_t(lid);
+    epoll_ctl(rt->loops[0]->epfd, EPOLL_CTL_ADD, fd, &ev);
+  });
+  return lid;
+}
+
+int dp_listener_close(void* h, int lid) {
+  auto* rt = static_cast<Runtime*>(h);
+  int fd = -1;
+  {
+    std::lock_guard<std::mutex> lk(rt->cmu);
+    if (lid < 0 || size_t(lid) >= rt->listeners.size()) return -1;
+    fd = rt->listeners[size_t(lid)].fd;
+    rt->listeners[size_t(lid)].fd = -1;
+  }
+  if (fd < 0) return -1;
+  loop_submit(rt, 0, [rt, fd] {
+    epoll_ctl(rt->loops[0]->epfd, EPOLL_CTL_DEL, fd, nullptr);
+    close(fd);
+  });
+  return 0;
+}
+
+int dp_listen_port(void* h, int lid) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::lock_guard<std::mutex> lk(rt->cmu);
+  if (lid < 0 || size_t(lid) >= rt->listeners.size()) return -1;
+  return rt->listeners[size_t(lid)].port;
+}
+
+int dp_register_echo(void* h, const char* service, const char* method) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::lock_guard<std::mutex> lk(rt->rmu);
+  rt->echo_services.emplace_back(service, method);
+  return 0;
+}
+
+// Returns conn id > 0, or 0 with *err_out=errno.
+uint64_t dp_connect(void* h, const char* host, int port, int timeout_ms,
+                    int* err_out) {
+  auto* rt = static_cast<Runtime*>(h);
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+  if (fd < 0) {
+    *err_out = errno;
+    return 0;
+  }
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    // resolve
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(host, nullptr, &hints, &res) != 0 || !res) {
+      close(fd);
+      *err_out = EHOSTUNREACH;
+      return 0;
+    }
+    addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    freeaddrinfo(res);
+  }
+  int rc = connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  if (rc != 0 && errno == EINPROGRESS) {
+    pollfd pfd{fd, POLLOUT, 0};
+    rc = poll(&pfd, 1, timeout_ms > 0 ? timeout_ms : 3000);
+    if (rc <= 0) {
+      close(fd);
+      *err_out = rc == 0 ? ETIMEDOUT : errno;
+      return 0;
+    }
+    int soerr = 0;
+    socklen_t slen = sizeof(soerr);
+    getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen);
+    if (soerr != 0) {
+      close(fd);
+      *err_out = soerr;
+      return 0;
+    }
+  } else if (rc != 0) {
+    *err_out = errno;
+    close(fd);
+    return 0;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  int bufsz = 4 << 20;
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bufsz, sizeof(bufsz));
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
+  auto c = create_conn(rt, fd, /*is_server=*/false);
+  activate_conn(rt, c);
+  *err_out = 0;
+  return c->id;
+}
+
+int dp_send(void* h, uint64_t conn_id, const uint8_t* data, uint64_t len) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::shared_ptr<Conn> c;
+  {
+    std::lock_guard<std::mutex> lk(rt->cmu);
+    auto it = rt->conns.find(conn_id);
+    if (it != rt->conns.end()) c = it->second;
+  }
+  if (!c) return DPE_NOTFOUND;
+  return conn_write(rt, c, data, len);
+}
+
+// Vectored variant: one RPC packet as up to 64 segments, written without
+// assembling (the IOBuf ref chain crosses the boundary as pointers).
+int dp_sendv(void* h, uint64_t conn_id, const uint8_t* const* bufs,
+             const uint64_t* lens, int nseg) {
+  if (nseg <= 0 || nseg > 64) return DPE_PROTOCOL;
+  auto* rt = static_cast<Runtime*>(h);
+  std::shared_ptr<Conn> c;
+  {
+    std::lock_guard<std::mutex> lk(rt->cmu);
+    auto it = rt->conns.find(conn_id);
+    if (it != rt->conns.end()) c = it->second;
+  }
+  if (!c) return DPE_NOTFOUND;
+  return conn_writev(rt, c, bufs, lens, nseg);
+}
+
+int dp_poll(void* h, DpEvent* out, int maxn, int timeout_ms) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::unique_lock<std::mutex> lk(rt->emu);
+  if (rt->events.empty()) {
+    rt->ecv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [rt] {
+      return !rt->events.empty() || !rt->running.load();
+    });
+  }
+  int n = 0;
+  while (n < maxn && !rt->events.empty()) {
+    out[n] = rt->events.front();
+    rt->event_bytes -=
+        out[n].meta_len + out[n].body_len + sizeof(DpEvent);
+    rt->events.pop_front();
+    n++;
+  }
+  return n;
+}
+
+void dp_free(void* base) { free(base); }
+
+void dp_conn_close(void* h, uint64_t conn_id) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::shared_ptr<Conn> c;
+  {
+    std::lock_guard<std::mutex> lk(rt->cmu);
+    auto it = rt->conns.find(conn_id);
+    if (it != rt->conns.end()) c = it->second;
+  }
+  if (!c) return;
+  loop_submit(rt, c->loop,
+              [rt, c] { conn_fail(rt, c, DPE_EOF, "closed locally"); });
+}
+
+int dp_conn_stats(void* h, uint64_t conn_id, uint64_t* in_bytes,
+                  uint64_t* out_bytes, uint64_t* in_msgs,
+                  uint64_t* out_msgs) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::lock_guard<std::mutex> lk(rt->cmu);
+  auto it = rt->conns.find(conn_id);
+  if (it == rt->conns.end()) return -1;
+  auto& c = it->second;
+  *in_bytes = c->in_bytes.load();
+  *out_bytes = c->out_bytes.load();
+  *in_msgs = c->in_msgs.load();
+  *out_msgs = c->out_msgs.load();
+  return 0;
+}
+
+// ------------------------------------------------------------------ bench
+// The reference measures its framework with C++ client binaries
+// (example/multi_threaded_echo_c++/client.cpp, rdma_performance/client.cpp).
+// This is ours: a pipelined echo client that drives the SAME engine lane
+// (dp_connect / conn_writev / the frame cutter) against a server, entirely
+// in C++, and reports QPS + latency percentiles + bandwidth.
+int dp_bench_echo(const char* host, int port, int nconns, int depth,
+                  uint64_t payload_len, int duration_ms,
+                  const char* service, const char* method,
+                  double* out_qps, double* out_gbps, double* out_p50_us,
+                  double* out_p99_us, double* out_p999_us) {
+  void* h = dp_rt_create(2, 0);
+  // request packet: header + meta(RequestMeta{service,method}, cid) + body
+  std::string reqmeta_tail;  // everything except the cid varint
+  {
+    std::string rm;
+    pb_put_tag(&rm, 1, 2);
+    pb_put_varint(&rm, strlen(service));
+    rm.append(service);
+    pb_put_tag(&rm, 2, 2);
+    pb_put_varint(&rm, strlen(method));
+    rm.append(method);
+    pb_put_tag(&reqmeta_tail, 1, 2);
+    pb_put_varint(&reqmeta_tail, rm.size());
+    reqmeta_tail.append(rm);
+  }
+  std::string body(size_t(payload_len), '\xab');
+  std::vector<uint64_t> conns;
+  for (int i = 0; i < nconns; i++) {
+    int err = 0;
+    uint64_t cid = dp_connect(h, host, port, 3000, &err);
+    if (!cid) {
+      dp_rt_shutdown(h);
+      return -1;
+    }
+    conns.push_back(cid);
+  }
+  std::atomic<uint64_t> done_count{0}, errors_seen{0};
+  std::atomic<bool> stop{false};
+  std::mutex lat_mu;
+  std::vector<double> latencies;
+  latencies.reserve(1 << 20);
+  // per-correlation-id send timestamps (cid space: conn_index * depth + slot)
+  std::vector<std::atomic<int64_t>> sent_ns(size_t(nconns) * depth);
+  auto now_ns = [] {
+    timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return int64_t(ts.tv_sec) * 1000000000 + ts.tv_nsec;
+  };
+  auto send_one = [&](int conn_idx, int slot) {
+    uint64_t cid = uint64_t(conn_idx) * depth + slot + 1;
+    std::string meta = reqmeta_tail;
+    pb_put_tag(&meta, 3, 0);
+    pb_put_varint(&meta, cid);
+    char hdr[kHeaderSize];
+    memcpy(hdr, "TRPC", 4);
+    uint32_t ms = htonl(uint32_t(meta.size()));
+    uint32_t bs = htonl(uint32_t(body.size()));
+    memcpy(hdr + 4, &ms, 4);
+    memcpy(hdr + 8, &bs, 4);
+    const uint8_t* bufs[3] = {reinterpret_cast<uint8_t*>(hdr),
+                              reinterpret_cast<const uint8_t*>(meta.data()),
+                              reinterpret_cast<const uint8_t*>(body.data())};
+    const uint64_t lens[3] = {kHeaderSize, meta.size(), body.size()};
+    sent_ns[cid - 1].store(now_ns(), std::memory_order_relaxed);
+    return dp_sendv(h, conns[size_t(conn_idx)], bufs, lens, 3);
+  };
+  // prime the pipeline
+  for (int ci = 0; ci < nconns; ci++) {
+    for (int s = 0; s < depth; s++) {
+      if (send_one(ci, s) != DPE_OK) {
+        dp_rt_shutdown(h);
+        return -2;
+      }
+    }
+  }
+  int64_t t_start = now_ns();
+  int64_t t_end = t_start + int64_t(duration_ms) * 1000000;
+  // consumer: poll completions, re-issue (the framework's event queue IS
+  // the completion channel; same lane Python uses)
+  std::vector<DpEvent> evs(256);
+  while (!stop.load()) {
+    int n = dp_poll(h, evs.data(), int(evs.size()), 50);
+    int64_t now = now_ns();
+    for (int i = 0; i < n; i++) {
+      DpEvent& ev = evs[i];
+      if (ev.kind == EV_FRAME) {
+        MetaLite m;
+        const uint8_t* mp = static_cast<const uint8_t*>(ev.meta);
+        if (parse_meta_lite(mp, mp + ev.meta_len, &m) && m.correlation_id) {
+          uint64_t cid = m.correlation_id;
+          int64_t t0 = sent_ns[cid - 1].load(std::memory_order_relaxed);
+          {
+            std::lock_guard<std::mutex> lk(lat_mu);
+            latencies.push_back(double(now - t0) / 1000.0);
+          }
+          done_count.fetch_add(1);
+          if (now < t_end) {
+            int conn_idx = int((cid - 1) / depth);
+            int slot = int((cid - 1) % depth);
+            send_one(conn_idx, slot);
+          }
+        }
+      } else if (ev.kind == EV_FAILED) {
+        errors_seen.fetch_add(1);
+      }
+      free(ev.base);
+    }
+    if (now >= t_end) {
+      // drain stragglers briefly, then stop
+      static const int64_t grace = 200000000;
+      if (now >= t_end + grace) stop.store(true);
+      if (n == 0) stop.store(true);
+    }
+    if (errors_seen.load() > uint64_t(nconns)) {
+      dp_rt_shutdown(h);
+      return -3;
+    }
+  }
+  int64_t elapsed = now_ns() - t_start;
+  double secs = double(elapsed) / 1e9;
+  uint64_t completed = done_count.load();
+  std::sort(latencies.begin(), latencies.end());
+  auto pct = [&](double p) -> double {
+    if (latencies.empty()) return 0.0;
+    size_t idx = size_t(p * double(latencies.size()));
+    if (idx >= latencies.size()) idx = latencies.size() - 1;
+    return latencies[idx];
+  };
+  *out_qps = double(completed) / secs;
+  *out_gbps = 2.0 * double(payload_len) * double(completed) / secs / 1e9;
+  *out_p50_us = pct(0.5);
+  *out_p99_us = pct(0.99);
+  *out_p999_us = pct(0.999);
+  dp_rt_shutdown(h);
+  return 0;
+}
+
+}  // extern "C"

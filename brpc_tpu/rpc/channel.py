@@ -59,6 +59,11 @@ class ChannelOptions:
     # pure-Python fallback is slow on MB payloads (the native core makes
     # this cheap — flip on for lossy transports).
     enable_checksum: bool = False
+    # carry trpc_std traffic over the C++ engine (rpc/native_transport.py):
+    # connect/write/frame-cut run on native threads, Python only completes
+    # calls. Ignored for non-TRPC protocols, unix:/tpu:// endpoints, or
+    # when the native core can't build (transparent Python fallback).
+    native_transport: bool = False
 
 
 class Channel:
@@ -151,6 +156,14 @@ class Channel:
             from brpc_tpu.tpu.tpusocket import get_tpu_socket
 
             return get_tpu_socket(ep)
+        if (self.options.native_transport and not ep.is_unix()
+                and getattr(self._protocol, "magic", None) == b"TRPC"):
+            from brpc_tpu.rpc.native_transport import get_dataplane
+
+            dp = get_dataplane()
+            if dp is not None:  # engine unavailable -> Python path below
+                return dp.get_or_connect(
+                    ep, int(self.options.connect_timeout_ms))
         # connection-scoped protocols (grpc/redis/thrift/...) can't share a
         # socket with each other or with frame protocols — key the shared
         # map by the protocol itself

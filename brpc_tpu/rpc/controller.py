@@ -288,10 +288,20 @@ class Controller:
         _cid.id_about_to_destroy(cid)
         _cid.id_unlock_and_destroy(cid)
         if done is not None:
-            try:
-                done(self)
-            except Exception:
-                pass
+            import threading as _threading
+
+            if getattr(_threading.current_thread(), "brpc_no_user_code",
+                       False):
+                # completing inline on an I/O/poller thread: user code may
+                # block (even issue sync RPCs) — hand it to a fiber worker
+                from brpc_tpu.fiber import runtime as _rt
+
+                _rt.start_background(_run_done, done, self)
+            else:
+                try:
+                    done(self)
+                except Exception:
+                    pass
 
     def join(self, timeout: Optional[float] = None) -> bool:
         if self._call_id is None:
@@ -319,6 +329,13 @@ def _handle_id_error(data, call_id: int, code: int) -> None:
     cntl._on_id_error(code)
 
 
+def _run_done(done, cntl) -> None:
+    try:
+        done(cntl)
+    except Exception:
+        pass
+
+
 def _fire_id_error(call_id: int, code: int) -> None:
     """Timer thread -> error channel (never blocks the timer thread long)."""
     _cid.id_error(call_id, code)
@@ -341,16 +358,21 @@ def handle_response_message(msg) -> None:
         # (newer attempt in flight), restore the entry so a later socket
         # failure still reaches the call (pre-claim semantics).
         sock = msg.socket
-        if sock is not None and not sock.failed:
-            try:
-                _cid.id_version(cid)
-            except _cid.IdGone:
-                return  # finished RPC: nothing to restore
-            sock.add_pending_id(cid)
-            if sock.failed:
-                # lost the race with set_failed's fan-out: deliver ourselves
-                sock.remove_pending_id(cid)
-                _cid.id_error(cid, sock.error_code or errors.EFAILEDSOCKET)
+        if sock is None:
+            return
+        try:
+            _cid.id_version(cid)
+        except _cid.IdGone:
+            return  # finished RPC: nothing to restore
+        if sock.failed:
+            # fan-out already ran without our entry: deliver ourselves
+            _cid.id_error(cid, sock.error_code or errors.EFAILEDSOCKET)
+            return
+        sock.add_pending_id(cid)
+        if sock.failed and sock.remove_pending_id(cid):
+            # set_failed snapshotted before our add AND nobody else took
+            # the entry (remove returned True) — deliver exactly once
+            _cid.id_error(cid, sock.error_code or errors.EFAILEDSOCKET)
         return
     payload, attachment = msg.protocol.split_attachment(msg)
     if not msg.protocol.verify_checksum(meta, payload):

@@ -122,6 +122,11 @@ class ServerOptions:
     redis_service: object = None      # policy/redis_protocol.RedisService
     thrift_service: object = None     # policy/thrift_protocol.ThriftService
     nshead_service: object = None     # policy/nshead.NsheadService
+    # serve TRPC traffic through the C++ engine (epoll + frame cutting in
+    # native threads, rpc/native_transport.py); other protocols on the same
+    # port are detached to the Python stack transparently. Ignored when the
+    # native core can't build or the address is unix:/tpu://.
+    native_dataplane: bool = False
 
 
 class Server:
@@ -142,6 +147,9 @@ class Server:
         self._idle_sweep_timer = None
         self._tpu_ordinal = -1          # device this server fronts (tpu://)
         self._tpu_endpoints: Set[object] = set()
+        self._native_lid = None         # native dataplane listener id
+        self._native_dp = None
+        self._native_echoes = []        # (service, method) C++ fast paths
         self.rpc_dumper = None
         if self.options.rpc_dump_dir:
             from brpc_tpu.trace.rpc_dump import RpcDumper
@@ -175,6 +183,9 @@ class Server:
 
             self._services["Health"] = GrpcHealthService(self)
         ep = EndPoint.parse(address)
+        if (self.options.native_dataplane and not ep.is_tpu()
+                and not ep.is_unix() and self._start_native(ep)):
+            return self
         if ep.is_tpu():
             # tpu://host:port/ordinal — the TCP port is the tunnel bootstrap
             # (the RDMA handshake listener); accepted connections upgrade to
@@ -207,9 +218,67 @@ class Server:
     def listen_endpoint(self) -> Optional[EndPoint]:
         return self._listen_ep
 
+    # ---------------------------------------------------- native dataplane
+    def _start_native(self, ep: EndPoint) -> bool:
+        """Bind through the C++ engine; False falls back to the Python
+        acceptor (engine unavailable)."""
+        from brpc_tpu.rpc.native_transport import get_dataplane
+
+        dp = get_dataplane()
+        if dp is None:
+            return False
+        host = ep.host or "0.0.0.0"
+        self._native_lid, port = dp.listen(self, host, ep.port)
+        self._native_dp = dp
+        self._listen_ep = EndPoint.from_ip_port(host, port)
+        self._running = True
+        self._logoff = False
+        for svc, method in self._native_echoes:
+            dp.register_echo(svc, method)
+        self._schedule_idle_sweep()
+        return True
+
+    def register_native_echo(self, service_name: str, method_name: str) -> None:
+        """Answer (service, method) entirely inside the C++ engine — the
+        rebuild's 'user code in C++' lane (the reference's services ARE
+        C++). The handler echoes the request body back (attachment
+        included); auth/limiters/spans do NOT run for these calls, exactly
+        like a reference service that bypasses ServerOptions hooks. Only
+        meaningful with ``native_dataplane=True``."""
+        self._native_echoes.append((service_name, method_name))
+        if getattr(self, "_native_dp", None) is not None:
+            self._native_dp.register_echo(service_name, method_name)
+
+    def adopt_connection(self, pysock, initial_bytes: bytes = b"",
+                         dispatcher=None) -> None:
+        """Take over an already-accepted connection fd (native DETACH path:
+        non-TRPC bytes arrived on a native port)."""
+        try:
+            peer = pysock.getpeername()
+        except OSError:
+            peer = None
+        remote = EndPoint.from_ip_port(*peer[:2]) \
+            if isinstance(peer, tuple) else None
+        sock = Socket(pysock, remote, dispatcher or pick_dispatcher())
+        sock.owner_server = self
+        if initial_bytes:
+            sock.read_buf.append(initial_bytes)
+        sock._on_readable = self._messenger.make_on_readable(sock)
+        with self._conn_lock:
+            self._connections.add(sock)
+        if initial_bytes:
+            # parse the seed BEFORE registering for events: cutting is
+            # serial per socket, and the dispatcher must not race this
+            self._messenger.cut_messages(sock)
+        if not sock.failed:
+            sock.register_read()
+
     def stop(self) -> None:
         """Graceful: reject new requests (ELOGOFF), keep serving in-flight."""
         self._logoff = True
+        if self._native_lid is not None:
+            # listener only — in-flight requests finish; join() tears down
+            self._native_dp.stop_listening(self._native_lid)
         if self._idle_sweep_timer is not None:
             from brpc_tpu.fiber.timer import timer_del
 
@@ -240,6 +309,9 @@ class Server:
             e.close()   # BYE + pool teardown; also fails the bootstrap conn
         for c in conns:
             c.close()
+        if self._native_lid is not None:
+            self._native_dp.teardown_listener(self._native_lid)
+            self._native_lid = None
         self._running = False
 
     @property

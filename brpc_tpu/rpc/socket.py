@@ -110,9 +110,13 @@ class Socket:
         with self._pending_lock:
             self._pending_ids.add(cid)
 
-    def remove_pending_id(self, cid: int) -> None:
+    def remove_pending_id(self, cid: int) -> bool:
+        """True iff the entry was present (caller owns its error delivery)."""
         with self._pending_lock:
-            self._pending_ids.discard(cid)
+            if cid in self._pending_ids:
+                self._pending_ids.discard(cid)
+                return True
+            return False
 
     # ------------------------------------------------------------- write path
     def write(self, data, id_wait: Optional[int] = None) -> int:

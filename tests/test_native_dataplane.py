@@ -1,0 +1,211 @@
+"""Native C++ dataplane tests (VERDICT r1 #3 — the native hot path).
+
+Pattern follows the reference's RPC integration tests (SURVEY §4): real
+loopback sockets, client and server through the public API, no mock
+transport. Covers both lanes (native engine / Python stack) in every
+pairing, the C++ native-service fast path, the DETACH fallback for
+non-TRPC protocols on a native port, and failure fanout.
+"""
+
+import socket as _socket
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.proto import echo_pb2
+from brpc_tpu.rpc import (
+    Channel,
+    ChannelOptions,
+    Controller,
+    RpcError,
+    Server,
+    ServerOptions,
+    Service,
+    Stub,
+)
+from brpc_tpu.rpc.native_transport import (
+    bench_echo_native,
+    dataplane_available,
+    get_dataplane,
+)
+
+pytestmark = pytest.mark.skipif(
+    not dataplane_available(), reason="native dataplane did not build")
+
+ECHO = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+
+class EchoImpl(Service):
+    DESCRIPTOR = ECHO
+
+    def Echo(self, cntl, request, done):
+        cntl.response_attachment = cntl.request_attachment
+        return echo_pb2.EchoResponse(message=request.message,
+                                     payload=request.payload)
+
+
+@pytest.fixture()
+def native_server():
+    server = Server(ServerOptions(native_dataplane=True))
+    server.add_service(EchoImpl())
+    server.start("127.0.0.1:0")
+    yield server
+    server.stop()
+    server.join()
+
+
+def _stub(server, native=False, timeout_ms=10000):
+    ch = Channel(ChannelOptions(protocol="trpc_std", timeout_ms=timeout_ms,
+                                native_transport=native))
+    ch.init(str(server.listen_endpoint()))
+    return Stub(ch, ECHO)
+
+
+class TestNativeServer:
+    def test_python_client_native_server(self, native_server):
+        stub = _stub(native_server, native=False)
+        r = stub.Echo(echo_pb2.EchoRequest(message="py", payload=b"p" * 1000))
+        assert r.message == "py" and r.payload == b"p" * 1000
+
+    def test_native_client_native_server(self, native_server):
+        stub = _stub(native_server, native=True)
+        r = stub.Echo(echo_pb2.EchoRequest(message="nn", payload=b"n" * 1000))
+        assert r.message == "nn" and r.payload == b"n" * 1000
+
+    def test_native_client_python_server(self):
+        server = Server(ServerOptions())
+        server.add_service(EchoImpl())
+        server.start("127.0.0.1:0")
+        try:
+            stub = _stub(server, native=True)
+            r = stub.Echo(echo_pb2.EchoRequest(message="np"))
+            assert r.message == "np"
+        finally:
+            server.stop()
+            server.join()
+
+    def test_attachment_roundtrip(self, native_server):
+        stub = _stub(native_server, native=True)
+        att = bytes(range(256)) * 64
+        cntl = Controller()
+        cntl.request_attachment = att
+        r = stub.Echo(echo_pb2.EchoRequest(message="a"), controller=cntl)
+        assert r.message == "a"
+        assert cntl.response_attachment == att
+
+    def test_large_payload(self, native_server):
+        stub = _stub(native_server, native=True, timeout_ms=30000)
+        payload = b"\x5a" * (8 << 20)
+        r = stub.Echo(echo_pb2.EchoRequest(message="big", payload=payload))
+        assert r.payload == payload
+
+    def test_concurrent_calls(self, native_server):
+        stub = _stub(native_server, native=True)
+        errs = []
+
+        def worker(i):
+            try:
+                for k in range(30):
+                    msg = f"t{i}.{k}"
+                    r = stub.Echo(echo_pb2.EchoRequest(message=msg))
+                    assert r.message == msg
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+
+    def test_native_echo_fastpath(self, native_server):
+        """C++-answered service: correct wire response, no Python handler."""
+        native_server.register_native_echo("EchoService", "Echo")
+        calls_before = native_server.requests_processed.get_value()
+        stub = _stub(native_server, native=True)
+        att = b"fast" * 100
+        cntl = Controller()
+        cntl.request_attachment = att
+        r = stub.Echo(echo_pb2.EchoRequest(message="cxx", payload=b"zz"),
+                      controller=cntl)
+        assert r.message == "cxx" and r.payload == b"zz"
+        assert cntl.response_attachment == att
+        # the Python service never saw it
+        assert native_server.requests_processed.get_value() == calls_before
+
+    def test_server_stop_fails_clients(self, native_server):
+        stub = _stub(native_server, native=True, timeout_ms=2000)
+        stub.Echo(echo_pb2.EchoRequest(message="ok"))
+        native_server.stop()
+        native_server.join()
+        with pytest.raises(RpcError):
+            for _ in range(5):  # conn teardown may race the first call
+                stub.Echo(echo_pb2.EchoRequest(message="down"))
+                time.sleep(0.1)
+
+
+class TestDetach:
+    def test_http_on_native_port(self, native_server):
+        """Non-TRPC bytes on a native port detach to the Python stack: the
+        builtin HTTP dashboard answers on the same listener."""
+        ep = native_server.listen_endpoint()
+        with _socket.create_connection((ep.host, ep.port), timeout=5) as s:
+            s.sendall(b"GET /health HTTP/1.1\r\nHost: t\r\n"
+                      b"Connection: close\r\n\r\n")
+            s.settimeout(5)
+            data = b""
+            while True:
+                try:
+                    chunk = s.recv(4096)
+                except (TimeoutError, OSError):
+                    break
+                if not chunk:
+                    break
+                data += chunk
+        assert data.startswith(b"HTTP/1.1 200")
+
+    def test_trpc_still_works_after_detach(self, native_server):
+        self.test_http_on_native_port(native_server)
+        stub = _stub(native_server, native=True)
+        assert stub.Echo(echo_pb2.EchoRequest(message="after")).message \
+            == "after"
+
+
+class TestNativeLaneBench:
+    def test_bench_echo_native_smoke(self, native_server):
+        native_server.register_native_echo("EchoService", "Echo")
+        ep = native_server.listen_endpoint()
+        res = bench_echo_native(ep.host, ep.port, conns=2, depth=2,
+                                payload=64, duration_ms=200)
+        assert res is not None
+        assert res["qps"] > 100, res
+        assert res["p99_us"] > 0
+
+
+class TestEngineBasics:
+    def test_connect_refused(self):
+        dp = get_dataplane()
+        # grab a port that is closed: bind+close
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        from brpc_tpu.butil.endpoint import EndPoint
+
+        with pytest.raises(ConnectionError):
+            dp.connect(EndPoint.from_ip_port("127.0.0.1", port),
+                       timeout_ms=500)
+
+    def test_peer_close_errors_pending(self, native_server):
+        """Kill the server mid-call: pending ids get errored, not hung."""
+        stub = _stub(native_server, native=True, timeout_ms=3000)
+        stub.Echo(echo_pb2.EchoRequest(message="warm"))
+        native_server.stop()
+        native_server.join()
+        t0 = time.monotonic()
+        with pytest.raises(RpcError):
+            stub.Echo(echo_pb2.EchoRequest(message="x"))
+        # failed fast via socket error, not the 3s timeout
+        assert time.monotonic() - t0 < 2.5

@@ -32,10 +32,16 @@ class EchoServiceImpl(Service):
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--listen", default="127.0.0.1:0")
+    ap.add_argument("--native", action="store_true",
+                    help="serve through the C++ dataplane engine")
+    ap.add_argument("--native_echo", action="store_true",
+                    help="answer EchoService.Echo entirely in C++")
     args = ap.parse_args(argv)
-    server = Server(ServerOptions())
+    server = Server(ServerOptions(native_dataplane=args.native))
     server.add_service(EchoServiceImpl())
     server.start(args.listen)
+    if args.native_echo:
+        server.register_native_echo("EchoService", "Echo")
     print(f"LISTEN {server.listen_endpoint()}", flush=True)
     try:
         sys.stdin.read()  # parent closing the pipe is the stop signal
