@@ -304,11 +304,22 @@ class H2Conn:
         if ftype == DATA:
             self._on_data(flags, sid, payload)
         elif ftype == HEADERS:
-            if flags & FLAG_PRIORITY:
-                payload = payload[5:]
+            # RFC 7540 §6.2 field order: pad length byte (if PADDED), THEN
+            # priority fields (if PRIORITY), then the fragment + padding
+            pad = 0
             if flags & FLAG_PADDED:
+                if not payload:
+                    raise H2Error(FRAME_SIZE_ERROR, "HEADERS missing pad len")
                 pad = payload[0]
-                payload = payload[1:len(payload) - pad]
+                payload = payload[1:]
+            if flags & FLAG_PRIORITY:
+                if len(payload) < 5:
+                    raise H2Error(FRAME_SIZE_ERROR,
+                                  "HEADERS missing priority fields")
+                payload = payload[5:]
+            if pad > len(payload):
+                raise H2Error(PROTOCOL_ERROR, "padding exceeds payload")
+            payload = payload[:len(payload) - pad]
             if len(payload) > MAX_HEADER_BLOCK:
                 raise H2Error(PROTOCOL_ERROR, "header block too large")
             self._hdr_block = bytearray(payload)
@@ -351,7 +362,11 @@ class H2Conn:
         # included (RFC 7540 §6.9.1) — account before stripping
         frame_len = len(payload)
         if flags & FLAG_PADDED:
+            if not payload:
+                raise H2Error(FRAME_SIZE_ERROR, "DATA missing pad length")
             pad = payload[0]
+            if pad > len(payload) - 1:
+                raise H2Error(PROTOCOL_ERROR, "padding exceeds payload")
             payload = payload[1:len(payload) - pad]
         st = self.streams.get(sid)
         if st is not None and st.recv_end:

@@ -81,6 +81,10 @@ class LoadBalancer:
             DoublyBufferedData(list))
         self._state: Dict[EndPoint, _NodeState] = {}
         self._state_lock = threading.Lock()
+        # cluster-recover policy (policy/cluster_recover.py); set via the
+        # LB spec string ("rr:min_working_instances=3 hold_seconds=2")
+        self.recover_policy = None
+        self._usable_cache = (0.0, 0)  # (expires_monotonic, count)
 
     # ---------------------------------------------------------- membership
     def reset_servers(self, nodes: List[ServerNode]) -> None:
@@ -110,6 +114,21 @@ class LoadBalancer:
         with self._servers.read() as lst:
             return len(lst)
 
+    def usable_count(self) -> int:
+        """Instances not parked by feedback/breaker (cluster-recover input).
+        Cached ~10ms: it sits on the per-request path while recovering
+        (the reference caches for detect_available_server_interval_ms,
+        cluster_recover_policy.cpp GetUsableServerCount)."""
+        now = time.monotonic()
+        expires, count = self._usable_cache
+        if now < expires:
+            return count
+        with self._servers.read() as lst:
+            count = sum(1 for n in lst
+                        if not self._node_state(n.endpoint).is_down)
+        self._usable_cache = (now + 0.01, count)
+        return count
+
     # ------------------------------------------------------------ feedback
     def feedback(self, endpoint: EndPoint, error_code: int,
                  latency_us: float) -> None:
@@ -124,7 +143,14 @@ class LoadBalancer:
 
     def _alive(self, nodes: List[ServerNode]) -> List[ServerNode]:
         alive = [n for n in nodes if not self._node_state(n.endpoint).is_down]
-        return alive or list(nodes)  # all parked -> try anyway
+        if alive:
+            return alive
+        if self.recover_policy is not None and nodes:
+            # selection exhausted every candidate — the cluster is down;
+            # arm de-thundered recovery (the reference arms whenever
+            # selection exhausts, round_robin_load_balancer.cpp:128-132)
+            self.recover_policy.start_recover()
+        return list(nodes)  # all parked -> try anyway
 
     # ------------------------------------------------------------- select
     def select_server(self, cntl=None) -> Optional[EndPoint]:
@@ -287,8 +313,17 @@ def register_load_balancer(name: str, factory: Callable[[], LoadBalancer]) -> No
 
 
 def create_load_balancer(name: str) -> LoadBalancer:
+    """``name`` or ``name:params``. Params currently configure the
+    cluster-recover policy (reference LB spec strings, e.g.
+    ``"rr:min_working_instances=3 hold_seconds=2"``)."""
+    base, _, params = name.partition(":")
     try:
-        return _registry[name]()
+        lb = _registry[base]()
     except KeyError:
-        raise ValueError(f"unknown load balancer {name!r}; "
+        raise ValueError(f"unknown load balancer {base!r}; "
                          f"have {sorted(_registry)}")
+    if params:
+        from brpc_tpu.policy.cluster_recover import parse_recover_params
+
+        lb.recover_policy = parse_recover_params(params)
+    return lb

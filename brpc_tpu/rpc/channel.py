@@ -147,9 +147,18 @@ class Channel:
     # ------------------------------------------------------------- internals
     def _select_socket(self, cntl: Controller):
         if self._lb is not None:
+            recover = self._lb.recover_policy
             ep = self._lb.select_server(cntl)
             if ep is None:
+                if recover is not None:
+                    # total cluster loss: arm de-thundered recovery
+                    # (reference cluster_recover_policy.cpp StartRecover)
+                    recover.start_recover()
                 raise ConnectionError("no available server")
+            if recover is not None and recover.recovering and \
+                    recover.do_reject(self._lb.usable_count()):
+                raise errors.SelectError(
+                    errors.EREJECT, "request shed during cluster recovery")
         else:
             ep = self._remote
         if ep.is_tpu():

@@ -425,3 +425,141 @@ class PartitionChannel(ParallelChannel):
             self.add_channel(sub, call_mapper=call_mapper,
                              response_merger=response_merger)
         return self
+
+
+class DynamicPartitionChannel:
+    """Capacity-weighted migration between partition schemes (reference
+    partition_channel.h:136 + policy/dynpart_load_balancer.cpp).
+
+    Servers tagged ``i/n`` group themselves by ``n`` into SCHEMES; each
+    scheme is a full PartitionChannel-style fan-out. A call picks ONE
+    scheme, weighted-random by the scheme's capacity (its server count —
+    the reference's dynpart LB weights sub-channels the same way,
+    dynpart_load_balancer.cpp:101-156), then fans out over that scheme's
+    partitions. Deploying a 4-partition tier next to a 2-partition tier
+    shifts traffic toward the new tier as its instances register; draining
+    the old tier finishes the migration with zero client changes.
+
+    The TPU mapping (SURVEY §2.5): schemes are shardings; capacity-weighted
+    scheme choice is re-sharding between device meshes while both are live.
+    """
+
+    def __init__(self, fail_limit: Optional[int] = None,
+                 success_limit: Optional[int] = None):
+        self.fail_limit = fail_limit
+        self.success_limit = success_limit
+        self._schemes: dict = {}      # partition_count -> _Scheme
+        self._lock = threading.Lock()
+        self._ns_thread = None
+        self._parser = None
+        self._lb_name = "rr"
+        self._options = None
+        self._call_mapper = None
+        self._response_merger = None
+
+    class _Scheme:
+        """One partition scheme: n per-partition LBs + a ParallelChannel
+        fanning out over them. capacity = total servers registered."""
+
+        def __init__(self, owner: "DynamicPartitionChannel", count: int):
+            from brpc_tpu.policy.load_balancers import create_load_balancer
+
+            self.count = count
+            self.capacity = 0
+            self.lbs = [create_load_balancer(owner._lb_name)
+                        for _ in range(count)]
+            self.fanout = ParallelChannel(fail_limit=owner.fail_limit,
+                                          success_limit=owner.success_limit)
+            for lb in self.lbs:
+                sub = Channel(owner._options or ChannelOptions())
+                sub.init_with_lb(lb)
+                self.fanout.add_channel(sub,
+                                        call_mapper=owner._call_mapper,
+                                        response_merger=owner._response_merger)
+
+        def reset(self, groups) -> None:
+            self.capacity = sum(len(g) for g in groups)
+            for lb, group in zip(self.lbs, groups):
+                lb.reset_servers(group)
+
+    def init(self, ns_url: str, parser: Optional[PartitionParser] = None,
+             lb_name: str = "rr", options: Optional[ChannelOptions] = None,
+             call_mapper: Optional[CallMapper] = None,
+             response_merger: Optional[ResponseMerger] = None,
+             ) -> "DynamicPartitionChannel":
+        from brpc_tpu.policy.naming import start_naming_service
+
+        self._parser = parser or PartitionParser()
+        self._lb_name = lb_name
+        self._options = options
+        self._call_mapper = call_mapper
+        self._response_merger = response_merger
+        self._ns_thread = start_naming_service(ns_url, self._listener())
+        return self
+
+    def _listener(self):
+        outer = self
+
+        class _Grouper:
+            def reset_servers(listener, nodes):
+                by_count: dict = {}
+                for node in nodes:
+                    parsed = outer._parser.parse(node.tag)
+                    if parsed is None:
+                        continue
+                    idx, cnt = parsed
+                    if cnt <= 0 or not 0 <= idx < cnt:
+                        continue
+                    by_count.setdefault(cnt, [[] for _ in range(cnt)])
+                    by_count[cnt][idx].append(node)
+                with outer._lock:
+                    for cnt, groups in by_count.items():
+                        scheme = outer._schemes.get(cnt)
+                        if scheme is None:
+                            scheme = outer._schemes[cnt] = \
+                                DynamicPartitionChannel._Scheme(outer, cnt)
+                        scheme.reset(groups)
+                    for cnt in list(outer._schemes):
+                        if cnt not in by_count:
+                            # scheme fully drained: drop it
+                            outer._schemes.pop(cnt)
+
+        return _Grouper()
+
+    # ------------------------------------------------------------- calling
+    def _pick_scheme(self):
+        from brpc_tpu.butil.misc import fast_rand_less_than
+
+        with self._lock:
+            schemes = [s for s in self._schemes.values() if s.capacity > 0]
+        if not schemes:
+            return None
+        total = sum(s.capacity for s in schemes)
+        r = fast_rand_less_than(total)
+        acc = 0
+        for s in schemes:
+            acc += s.capacity
+            if r < acc:
+                return s
+        return schemes[-1]
+
+    def scheme_capacities(self) -> dict:
+        with self._lock:
+            return {cnt: s.capacity for cnt, s in self._schemes.items()}
+
+    def call_method(self, method, request, response=None,
+                    controller: Optional[Controller] = None, done=None):
+        scheme = self._pick_scheme()
+        if scheme is None:
+            cntl = controller or Controller()
+            cntl._response = response
+            cntl.set_failed(errors.EHOSTDOWN,
+                            "no partition scheme has servers")
+            if done is not None:
+                done(cntl)
+                return cntl
+            raise RpcError(cntl)
+        cntl = controller or Controller()
+        cntl.partition_count = scheme.count  # observable routing decision
+        return scheme.fanout.call_method(method, request, response=response,
+                                         controller=cntl, done=done)

@@ -126,6 +126,10 @@ class Controller:
         cid = self._call_id
         try:
             sock = self._channel._select_socket(self)
+        except errors.SelectError as e:
+            self._error_text = str(e)
+            _cid.id_error(cid, e.code)
+            return
         except Exception as e:
             # route the failure through the error channel (deferred while we
             # hold the lock) so retry logic sees one uniform path

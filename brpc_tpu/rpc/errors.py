@@ -24,6 +24,16 @@ ERESPONSE = 2002       # bad response (parse failure / checksum mismatch)
 EAUTH = 2003           # authentication failed
 EOVERCROWDED = 2004    # server too busy (write queue overflow)
 ESTREAMCLOSED = 2005   # stream closed by peer
+EREJECT = 2007         # cluster-recover policy shed this request
+
+
+class SelectError(Exception):
+    """Server-selection failure carrying the error code to report (raised
+    by Channel._select_socket, routed by Controller._issue_rpc)."""
+
+    def __init__(self, code: int, text: str = ""):
+        super().__init__(text)
+        self.code = code
 
 _TEXT = {
     OK: "OK",
@@ -43,6 +53,7 @@ _TEXT = {
     ERESPONSE: "bad response",
     EAUTH: "authentication failed",
     EOVERCROWDED: "server overcrowded",
+    EREJECT: "request shed during cluster recovery",
     ESTREAMCLOSED: "stream closed",
 }
 

@@ -484,3 +484,150 @@ class TestHealthCheck:
         from brpc_tpu.rpc.health_check import tcp_probe
 
         assert tcp_probe(EndPoint.parse("127.0.0.1:1"), timeout=0.3) is False
+
+
+class TestDynamicPartitionChannel:
+    """Capacity migration between partition schemes (reference
+    partition_channel.h:136, dynpart_load_balancer.cpp)."""
+
+    def _make(self, servers):
+        from brpc_tpu.rpc.combo_channels import DynamicPartitionChannel
+
+        a, b = (str(s.listen_endpoint()) for s in servers)
+        # two schemes live at once: 2-partition tier (1 server each) and a
+        # 4-partition tier (the same two servers doubled up)
+        url = (f"list://{a} 0/2,{b} 1/2,"
+               f"{a} 0/4,{b} 1/4,{a} 2/4,{b} 3/4")
+
+        class CountMerger(ResponseMerger):
+            def merge(self, response, sub):
+                response.message += "."
+                return 0
+
+        dpc = DynamicPartitionChannel()
+        dpc.init(url, response_merger=CountMerger())
+        return dpc
+
+    def test_traffic_splits_by_capacity(self):
+        impls = [NamedEcho("a"), NamedEcho("b")]
+        servers = start_servers(*impls)
+        try:
+            dpc = self._make(servers)
+            assert dpc.scheme_capacities() == {2: 2, 4: 4}
+            fan_counts = set()
+            for _ in range(60):
+                resp = dpc.call_method(ECHO_MD,
+                                       echo_pb2.EchoRequest(message="x"))
+                fan_counts.add(len(resp.message))
+            # both schemes must carry traffic (P[miss] <= (4/6)^60)
+            assert fan_counts == {2, 4}, fan_counts
+        finally:
+            stop_servers(servers)
+
+    def test_drain_finishes_migration(self, tmp_path):
+        from brpc_tpu.policy.naming import parse_server_item
+        from brpc_tpu.rpc.combo_channels import DynamicPartitionChannel
+
+        impls = [NamedEcho("a"), NamedEcho("b")]
+        servers = start_servers(*impls)
+        try:
+            a, b = (str(s.listen_endpoint()) for s in servers)
+            both_tiers = (f"{a} 0/2\n{b} 1/2\n"
+                          f"{a} 0/4\n{b} 1/4\n{a} 2/4\n{b} 3/4\n")
+            ns_file = tmp_path / "cluster.lst"
+            ns_file.write_text(both_tiers)
+
+            class CountMerger(ResponseMerger):
+                def merge(self, response, sub):
+                    response.message += "."
+                    return 0
+
+            dpc = DynamicPartitionChannel()
+            dpc.init(f"file://{ns_file}", response_merger=CountMerger())
+            assert dpc.scheme_capacities() == {2: 2, 4: 4}
+            # the old 2-partition tier drains: the naming FILE changes first
+            # (so any periodic refresh agrees), then the update is pushed
+            new_tier = f"{a} 0/4\n{b} 1/4\n{a} 2/4\n{b} 3/4\n"
+            ns_file.write_text(new_tier)
+            nodes = [parse_server_item(line)
+                     for line in new_tier.splitlines()]
+            dpc._listener().reset_servers(nodes)
+            assert dpc.scheme_capacities() == {4: 4}
+            for _ in range(10):
+                resp = dpc.call_method(ECHO_MD,
+                                       echo_pb2.EchoRequest(message="x"))
+                assert len(resp.message) == 4  # always the 4-way fanout
+        finally:
+            stop_servers(servers)
+
+
+class TestClusterRecover:
+    def test_policy_sheds_proportionally(self):
+        from brpc_tpu.policy.cluster_recover import (
+            DefaultClusterRecoverPolicy)
+
+        pol = DefaultClusterRecoverPolicy(min_working_instances=4,
+                                          hold_seconds=60)
+        assert not pol.do_reject(0)  # not recovering yet -> no shedding
+        pol.start_recover()
+        verdicts = [pol.do_reject(1) for _ in range(400)]
+        frac = sum(verdicts) / len(verdicts)
+        assert 0.55 < frac < 0.95, frac   # expect ~75% shed at 1/4 capacity
+        assert pol.recovering
+        # full capacity back -> recovery over, nothing shed
+        assert not pol.do_reject(4)
+        assert not pol.recovering
+        assert not pol.do_reject(1)
+
+    def test_policy_stops_after_hold(self):
+        from brpc_tpu.policy.cluster_recover import (
+            DefaultClusterRecoverPolicy)
+
+        pol = DefaultClusterRecoverPolicy(min_working_instances=8,
+                                          hold_seconds=0.1)
+        pol.start_recover()
+        pol.do_reject(2)
+        time.sleep(0.15)
+        pol.do_reject(2)          # usable stable for hold_seconds -> stop
+        assert not pol.recovering
+
+    def test_channel_integration(self):
+        from brpc_tpu.policy.load_balancers import (ServerNode,
+                                                    create_load_balancer)
+
+        impl = NamedEcho("up")
+        (server,) = start_servers(impl)
+        try:
+            lb = create_load_balancer(
+                "rr:min_working_instances=2 hold_seconds=120")
+            assert lb.recover_policy is not None
+            ch = Channel(ChannelOptions(timeout_ms=2000, max_retry=0))
+            ch.init_with_lb(lb)
+            stub = Stub(ch, ECHO_DESC)
+            # empty cluster: EHOSTDOWN and recovery armed
+            with pytest.raises(RpcError):
+                stub.Echo(echo_pb2.EchoRequest(message="x"))
+            assert lb.recover_policy.recovering
+            # half capacity back: some calls shed with EREJECT, some pass
+            lb.reset_servers([ServerNode(server.listen_endpoint())])
+            outcomes = set()
+            for _ in range(200):
+                try:
+                    stub.Echo(echo_pb2.EchoRequest(message="x"))
+                    outcomes.add("ok")
+                except RpcError as e:
+                    assert e.error_code == errors.EREJECT, e
+                    outcomes.add("shed")
+                if outcomes == {"ok", "shed"}:
+                    break
+            assert outcomes == {"ok", "shed"}
+            # full capacity: recovery ends, everything flows
+            lb.reset_servers([ServerNode(server.listen_endpoint()),
+                              ServerNode(server.listen_endpoint(),
+                                         tag="dup")])
+            time.sleep(0.05)  # let the ~10ms usable_count cache expire
+            for _ in range(5):
+                stub.Echo(echo_pb2.EchoRequest(message="x"))
+            assert not lb.recover_policy.recovering
+        finally:
+            stop_servers([server])
