@@ -43,14 +43,24 @@ def parse_server_item(item: str) -> Optional[ServerNode]:
 
 
 class NamingService:
-    """Subclass: implement get_servers() -> List[ServerNode]."""
+    """Subclass: implement get_servers() -> List[ServerNode].
+
+    Watch-style services (consul blocking queries etc.) additionally set
+    ``supports_watch = True`` and implement ``watch(push, stop_event)`` — a
+    blocking loop calling ``push(nodes)`` on every membership change; the
+    NamingServiceThread then pushes changes the moment they happen instead
+    of on a polling interval."""
 
     scheme = "base"
+    supports_watch = False
 
     def __init__(self, path: str):
         self.path = path
 
     def get_servers(self) -> List[ServerNode]:
+        raise NotImplementedError
+
+    def watch(self, push, stop_event) -> None:
         raise NotImplementedError
 
 
@@ -119,9 +129,126 @@ _schemes: Dict[str, Callable[[str], NamingService]] = {
 }
 
 
+class ConsulNamingService(NamingService):
+    """Watch-style membership via consul's blocking queries (reference
+    policy/consul_naming_service.cpp: GET /v1/health/service/<name> with
+    index/wait long-poll; changes push IMMEDIATELY, no polling interval).
+
+    url: consul://host:port/service_name
+    """
+
+    scheme = "consul"
+    supports_watch = True
+    WAIT = "10s"
+
+    def __init__(self, path: str):
+        super().__init__(path)
+        authority, _, service = path.partition("/")
+        host, _, port = authority.partition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port or 8500)
+        self.service = service
+        self._index = 0
+
+    def _query(self, index: int, wait: str = "") -> tuple:
+        import http.client
+        import json
+
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
+        try:
+            q = f"/v1/health/service/{self.service}?passing=1&index={index}"
+            if wait:
+                q += f"&wait={wait}"
+            conn.request("GET", q)
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"consul HTTP {resp.status}")
+            new_index = int(resp.headers.get("X-Consul-Index", "0") or 0)
+            nodes = []
+            for entry in json.loads(body.decode() or "[]"):
+                svc = entry.get("Service", {})
+                addr = svc.get("Address") or \
+                    entry.get("Node", {}).get("Address", "")
+                port_num = int(svc.get("Port", 0))
+                if not addr or not port_num:
+                    continue
+                tags = svc.get("Tags") or []
+                nodes.append(ServerNode(EndPoint.from_ip_port(addr, port_num),
+                                        tag=tags[0] if tags else ""))
+            return nodes, new_index
+        finally:
+            conn.close()
+
+    def get_servers(self) -> List[ServerNode]:
+        nodes, self._index = self._query(0)
+        return nodes
+
+    def watch(self, push, stop_event) -> None:
+        """Blocking-query loop: each call hangs until membership changes
+        (or the wait expires); every change pushes instantly."""
+        while not stop_event.is_set():
+            nodes, new_index = self._query(self._index, wait=self.WAIT)
+            if stop_event.is_set():
+                return
+            if new_index <= 0:
+                # a 200 without X-Consul-Index isn't consul — raising lets
+                # the watch thread back off instead of busy-looping
+                # immediate index=0 queries
+                raise RuntimeError(
+                    "consul response missing X-Consul-Index "
+                    "(is the endpoint really a consul agent?)")
+            if new_index != self._index:
+                self._index = new_index
+                push(nodes)
+
+
+class RemoteFileNamingService(NamingService):
+    """Server list fetched from an HTTP URL, refreshed periodically
+    (reference policy/remote_file_naming_service.cpp).
+
+    url: remotefile://host:port/path
+    """
+
+    scheme = "remotefile"
+
+    def __init__(self, path: str):
+        super().__init__(path)
+        authority, _, rel = path.partition("/")
+        host, _, port = authority.partition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port or 80)
+        self.rel = "/" + rel
+
+    def get_servers(self) -> List[ServerNode]:
+        import http.client
+
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=10)
+        try:
+            conn.request("GET", self.rel)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise RuntimeError(f"remotefile HTTP {resp.status}")
+            nodes = []
+            for line in resp.read().decode().splitlines():
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                node = parse_server_item(line)
+                if node is not None:
+                    nodes.append(node)
+            return nodes
+        finally:
+            conn.close()
+
+
 def register_naming_service(scheme: str,
                             factory: Callable[[str], NamingService]) -> None:
     _schemes[scheme] = factory
+
+
+_schemes["consul"] = ConsulNamingService
+_schemes["remotefile"] = RemoteFileNamingService
 
 
 class NamingServiceThread:
@@ -152,19 +279,35 @@ class NamingServiceThread:
     def _refresh(self) -> None:
         try:
             nodes = self._ns.get_servers()
-            self.last_error = None
         except Exception as e:
             self.last_error = str(e)
             return  # keep the previous list on resolution failure
+        self._push(nodes)
+
+    def _run(self) -> None:
+        if self._ns.supports_watch:
+            # watch loop: changes push instantly; reconnect with backoff
+            backoff = 0.1
+            while not self._stop.is_set():
+                try:
+                    self._ns.watch(self._push, self._stop)
+                    backoff = 0.1
+                except Exception as e:
+                    self.last_error = str(e)
+                    self._stop.wait(backoff)
+                    backoff = min(backoff * 2, 5.0)
+            return
+        while not self._stop.wait(self._interval):
+            self._refresh()
+
+    def _push(self, nodes: List[ServerNode]) -> None:
+        """Watch callback: deliver a membership change to every listener."""
+        self.last_error = None
         with self._lock:
             self.last_servers = nodes
             listeners = list(self._listeners)
         for lb in listeners:
             lb.reset_servers(nodes)
-
-    def _run(self) -> None:
-        while not self._stop.wait(self._interval):
-            self._refresh()
 
     def stop(self) -> None:
         self._stop.set()

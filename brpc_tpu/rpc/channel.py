@@ -64,6 +64,9 @@ class ChannelOptions:
     # calls. Ignored for non-TRPC protocols, unix:/tpu:// endpoints, or
     # when the native core can't build (transparent Python fallback).
     native_transport: bool = False
+    # TLS to the server (rpc/ssl_helper.ClientSslOptions); ALPN list there
+    # drives h2 selection. None = plaintext.
+    ssl: object = None
 
 
 class Channel:
@@ -166,6 +169,7 @@ class Channel:
 
             return get_tpu_socket(ep)
         if (self.options.native_transport and not ep.is_unix()
+                and self.options.ssl is None
                 and getattr(self._protocol, "magic", None) == b"TRPC"):
             from brpc_tpu.rpc.native_transport import get_dataplane
 
@@ -180,7 +184,7 @@ class Channel:
                      if hasattr(self._protocol, "issue_request") else "")
         return self._socket_map.get_or_create(
             ep, connect_timeout=self.options.connect_timeout_ms / 1000.0,
-            signature=signature,
+            signature=signature, ssl_options=self.options.ssl,
         )
 
     def _on_rpc_end(self, cntl: Controller) -> None:

@@ -87,3 +87,32 @@ class HealthChecker:
 
     def stop(self) -> None:
         self._stop.set()
+
+
+def http_probe(path: str = "/health", timeout: float = 1.0):
+    """App-level probe factory (reference details/health_check.cpp:34-107
+    HealthCheckChannel: an RPC on the endpoint must SUCCEED — a machine
+    that accepts TCP but serves errors stays parked). Success = HTTP 2xx
+    on ``path``."""
+
+    def probe(ep: EndPoint) -> bool:
+        try:
+            fam, addr = ep.sockaddr()
+            with _socket.socket(fam, _socket.SOCK_STREAM) as s:
+                s.settimeout(timeout)
+                s.connect(addr)
+                host = ep.host or "localhost"
+                s.sendall(f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
+                          f"Connection: close\r\n\r\n".encode())
+                head = b""
+                while b"\r\n" not in head and len(head) < 256:
+                    chunk = s.recv(256)
+                    if not chunk:
+                        break
+                    head += chunk
+            parts = head.split(None, 2)
+            return len(parts) >= 2 and parts[1][:1] == b"2"
+        except (OSError, ValueError):
+            return False
+
+    return probe

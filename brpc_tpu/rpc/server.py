@@ -127,6 +127,10 @@ class ServerOptions:
     # port are detached to the Python stack transparently. Ignored when the
     # native core can't build or the address is unix:/tpu://.
     native_dataplane: bool = False
+    # TLS on the listener (rpc/ssl_helper.ServerSslOptions). The SAME port
+    # keeps serving plaintext: the first byte of each connection is sniffed
+    # (0x16 = TLS) before wrapping, like the reference single-port design.
+    ssl: object = None
 
 
 class Server:
@@ -150,6 +154,7 @@ class Server:
         self._native_lid = None         # native dataplane listener id
         self._native_dp = None
         self._native_echoes = []        # (service, method) C++ fast paths
+        self._ssl_ctx = None            # built lazily from options.ssl
         self.rpc_dumper = None
         if self.options.rpc_dump_dir:
             from brpc_tpu.trace.rpc_dump import RpcDumper
@@ -182,9 +187,15 @@ class Server:
             from brpc_tpu.builtin.grpc_health import GrpcHealthService
 
             self._services["Health"] = GrpcHealthService(self)
+        if self.options.ssl is not None and self._ssl_ctx is None:
+            # fail FAST on a bad cert path — not per-connection at runtime
+            from brpc_tpu.rpc.ssl_helper import build_server_context
+
+            self._ssl_ctx = build_server_context(self.options.ssl)
         ep = EndPoint.parse(address)
         if (self.options.native_dataplane and not ep.is_tpu()
-                and not ep.is_unix() and self._start_native(ep)):
+                and not ep.is_unix() and self.options.ssl is None
+                and self._start_native(ep)):
             return self
         if ep.is_tpu():
             # tpu://host:port/ordinal — the TCP port is the tunnel bootstrap
@@ -326,20 +337,62 @@ class Server:
                 conn, peer = self._listen_sock.accept()
             except (BlockingIOError, OSError):
                 return
-            conn.setblocking(False)
             try:
                 conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
             except OSError:
                 pass
             remote = EndPoint.from_ip_port(*peer[:2]) if isinstance(peer, tuple) else None
-            # accepted connections spread across the dispatcher pool; only
-            # the listener stays pinned to self._dispatcher
-            sock = Socket(conn, remote, pick_dispatcher())
-            sock.owner_server = self
-            sock._on_readable = self._messenger.make_on_readable(sock)
-            sock.register_read()
-            with self._conn_lock:
-                self._connections.add(sock)
+            if self.options.ssl is not None:
+                # sniff + handshake block — run in a fiber, never on the
+                # dispatcher (a slow TLS client must not stall the loop)
+                from brpc_tpu.fiber import runtime as _rt
+
+                _rt.start_background(self._tls_sniff_accept, conn, remote)
+                continue
+            conn.setblocking(False)
+            self._register_connection(conn, remote)
+
+    def _register_connection(self, conn, remote) -> Socket:
+        # accepted connections spread across the dispatcher pool; only
+        # the listener stays pinned to self._dispatcher
+        sock = Socket(conn, remote, pick_dispatcher())
+        sock.owner_server = self
+        sock._on_readable = self._messenger.make_on_readable(sock)
+        with self._conn_lock:
+            self._connections.add(sock)
+        sock.register_read()
+        return sock
+
+    def _tls_sniff_accept(self, conn, remote) -> None:
+        """First-byte sniff: 0x16 = TLS handshake record -> wrap; anything
+        else keeps the plaintext path. One port serves both (reference
+        ssl_helper.cpp sniffing in the socket input path)."""
+        from brpc_tpu.rpc import ssl_helper
+
+        wrapped = False
+        try:
+            conn.settimeout(5.0)
+            first = conn.recv(1, _socket.MSG_PEEK)
+            if first and first[0] == ssl_helper.TLS_HANDSHAKE_BYTE:
+                conn = ssl_helper.wrap_server_socket(conn, self._ssl_ctx)
+                wrapped = True
+            else:
+                conn.setblocking(False)
+        except OSError as e:
+            import logging
+
+            logging.getLogger("brpc_tpu").warning(
+                "TLS accept from %s failed: %s", remote, e)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        sock = self._register_connection(conn, remote)
+        if wrapped:
+            # the handshake read may have pulled the client's first request
+            # bytes into OpenSSL's buffer — epoll won't announce them
+            sock.kick_read()
 
     def _schedule_idle_sweep(self) -> None:
         """Re-arming 5 s sweep closing connections idle beyond the

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import errno as _errno
 import socket as _socket
+import ssl as _ssl
 import threading
 import time as _time
 from collections import deque
@@ -67,6 +68,8 @@ class Socket:
         self.user_data = None       # server conn state, stream impl, etc.
         self.owner_server = None    # set for accepted connections
         self.last_active = _time.monotonic()  # idle-timeout bookkeeping
+        self.ssl = False            # transport is TLS-wrapped
+        self.alpn: Optional[str] = None  # ALPN-negotiated protocol (client)
         self.socket_id = _socket_pool.insert(self)
         self._on_readable = on_readable
         self._close_lock = threading.Lock()
@@ -77,19 +80,28 @@ class Socket:
     # --------------------------------------------------------------- factory
     @staticmethod
     def connect(remote: EndPoint, dispatcher, timeout: float = 3.0,
-                on_readable: Optional[Callable] = None) -> "Socket":
+                on_readable: Optional[Callable] = None,
+                ssl_options=None) -> "Socket":
         fam, addr = remote.sockaddr()
         sock = _socket.socket(fam, _socket.SOCK_STREAM)
         try:
             sock.settimeout(timeout)
             sock.connect(addr)
+            if fam != _socket.AF_UNIX:
+                sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            if ssl_options is not None:
+                from brpc_tpu.rpc.ssl_helper import (alpn_selected,
+                                                     wrap_client_socket)
+
+                sock = wrap_client_socket(sock, ssl_options, timeout=timeout)
         except OSError:
             sock.close()
             raise
         sock.setblocking(False)
-        if fam != _socket.AF_UNIX:
-            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
         s = Socket(sock, remote, dispatcher, on_readable=on_readable)
+        if ssl_options is not None:
+            s.ssl = True
+            s.alpn = alpn_selected(sock)
         s.register_read()
         return s
 
@@ -168,7 +180,11 @@ class Socket:
                 head = self._write_queue[0]
             try:
                 n = self._sock.send(head)
-            except BlockingIOError:
+            except (BlockingIOError, _ssl.SSLWantWriteError,
+                    _ssl.SSLWantReadError):
+                # TLS renegotiation can want a READ to make write progress;
+                # the read interest is always armed, so re-arming write
+                # covers both cases
                 self.dispatcher.enable_write(self.fd, self._on_writable)
                 return
             except OSError as e:
@@ -186,6 +202,24 @@ class Socket:
     def _on_writable(self) -> None:
         self._drain_write_queue()
 
+    def _retry_read_on_writable(self) -> None:
+        """EPOLLOUT follow-up for a TLS read that wanted a write."""
+        with self._write_lock:
+            if not self._write_registered:
+                self.dispatcher.disable_write(self.fd)
+        if self._on_readable is not None:
+            self._on_readable()
+
+    def kick_read(self) -> None:
+        """Deliver one synthetic readable event on a fiber. A TLS handshake
+        can leave already-decrypted application bytes buffered inside
+        OpenSSL; epoll never announces those, so the registration site must
+        kick once."""
+        if self._on_readable is not None and not self.failed:
+            from brpc_tpu.fiber import runtime as _rt
+
+            _rt.start_background(self._on_readable)
+
     # -------------------------------------------------------------- read path
     def drain_recv(self) -> int:
         """recv until EAGAIN into read_buf; returns bytes read, -1 on a hard
@@ -196,7 +230,14 @@ class Socket:
         while True:
             try:
                 chunk = self._sock.recv(RECV_CHUNK)
-            except BlockingIOError:
+            except (BlockingIOError, _ssl.SSLWantReadError):
+                break
+            except _ssl.SSLWantWriteError:
+                # TLS read needs a WRITE (renegotiation/KeyUpdate while the
+                # send buffer is full): retry the read on writability, else
+                # the connection wedges until unrelated traffic arrives
+                self.dispatcher.enable_write(self.fd,
+                                             self._retry_read_on_writable)
                 break
             except OSError as e:
                 self.set_failed(errors.EFAILEDSOCKET, f"recv: {e}")

@@ -31,7 +31,11 @@ class SocketMap:
         self._create_locks: Dict[tuple, threading.Lock] = {}
 
     def get_or_create(self, remote: EndPoint, connect_timeout: float = 3.0,
-                      signature: str = "") -> Socket:
+                      signature: str = "", ssl_options=None) -> Socket:
+        if ssl_options is not None:
+            # TLS sockets never pool with plaintext ones (nor with TLS
+            # sockets using different options)
+            signature = f"{signature}|{ssl_options.cache_key()}"
         key = (remote, signature)
         with self._lock:
             sock = self._map.get(key)
@@ -49,9 +53,14 @@ class SocketMap:
                 disp = pick_dispatcher()
             else:
                 disp = self._dispatcher
-            sock = Socket.connect(remote, disp, timeout=connect_timeout)
+            sock = Socket.connect(remote, disp, timeout=connect_timeout,
+                                  ssl_options=ssl_options)
             sock._on_readable = self._messenger.make_on_readable(sock)
             sock.register_read()
+            if ssl_options is not None:
+                # server bytes (h2 SETTINGS etc.) may already sit decrypted
+                # in the TLS object from the handshake read
+                sock.kick_read()
             with self._lock:
                 self._map[key] = sock
             return sock
