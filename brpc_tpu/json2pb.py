@@ -1,49 +1,319 @@
-"""json2pb — JSON <-> protobuf bridging for the HTTP protocol family.
+"""json2pb — bidirectional JSON <-> protobuf bridge with conversion options.
 
 Counterpart of the reference's ``src/json2pb`` (``pb_to_json.cpp`` /
-``json_to_pb.cpp``): the HTTP protocol serves protobuf services to JSON
-clients by converting request bodies to messages and responses back. We
-build on ``google.protobuf.json_format`` rather than a hand-rolled walker —
-the conversion rules (int64 as string, bytes as base64, enums by name) match
-proto3 JSON mapping, which is what the reference's grpc/http gateway peers
-expect.
+``json_to_pb.cpp`` and their Pb2JsonOptions / Json2PbOptions): the HTTP
+protocol family serves protobuf services to JSON clients, and proxies need
+control over the conversion rules, not a fixed mapping. This is an
+options-driven descriptor walker of our own:
+
+  - maps (string/int/bool keys), nested + repeated messages, oneof
+  - enums by name or number (``enum_as_name``), unknown enum tolerance
+  - bytes as base64 (or latin-1 passthrough when ``bytes_to_base64=False``
+    — the reference's raw-bytes escape hatch)
+  - 64-bit ints as JSON strings (``int64_as_string``) for JS safety
+  - NaN/Infinity round-tripping for float/double
+  - ``always_print_primitive_fields`` / ``jsonify_empty_array`` dump shaping
+  - unknown-field tolerance on parse (``ignore_unknown_fields``),
+    camelCase json_name acceptance
+
+Limitation: well-known types (google.protobuf.Timestamp/Duration/Struct/
+wrappers) are treated as plain messages, not their proto3 JSON special
+forms — none of this framework's schemas use them; add handling before
+introducing one.
+
+The old two-function surface (json_to_pb / pb_to_json) is kept for the
+HTTP family; options objects are additive.
 """
 
 from __future__ import annotations
 
+import base64
+import json
+import math
+from dataclasses import dataclass
 from typing import Optional, Type
 
-from google.protobuf import json_format
+from google.protobuf import descriptor as _desc
+
+_FD = _desc.FieldDescriptor
+
+_INT_TYPES = {
+    _FD.CPPTYPE_INT32, _FD.CPPTYPE_INT64,
+    _FD.CPPTYPE_UINT32, _FD.CPPTYPE_UINT64,
+}
+_WIDE_TYPES = {_FD.CPPTYPE_INT64, _FD.CPPTYPE_UINT64}
+_FLOAT_TYPES = {_FD.CPPTYPE_FLOAT, _FD.CPPTYPE_DOUBLE}
 
 
 class Json2PbError(ValueError):
     pass
 
 
-def json_to_pb(data, message_class: Type, ignore_unknown_fields: bool = True):
+@dataclass
+class Pb2JsonOptions:
+    """reference pb_to_json.h Pb2JsonOptions (subset, renamed pythonic)."""
+
+    enum_as_name: bool = True
+    bytes_to_base64: bool = True
+    int64_as_string: bool = True
+    jsonify_empty_array: bool = False
+    always_print_primitive_fields: bool = False
+    pretty: bool = False
+
+
+@dataclass
+class Json2PbOptions:
+    """reference json_to_pb.h Json2PbOptions (subset)."""
+
+    base64_to_bytes: bool = True
+    ignore_unknown_fields: bool = True
+    allow_unknown_enum: bool = False  # drop unknown enum names vs error
+
+
+# ------------------------------------------------------------------ pb->json
+def _value_to_json(field, value, opts: Pb2JsonOptions):
+    cpp = field.cpp_type
+    if cpp == _FD.CPPTYPE_MESSAGE:
+        return _message_to_dict(value, opts)
+    if cpp == _FD.CPPTYPE_ENUM:
+        if opts.enum_as_name:
+            ev = field.enum_type.values_by_number.get(value)
+            return ev.name if ev is not None else value
+        return value
+    if cpp == _FD.CPPTYPE_BOOL:
+        return bool(value)
+    if cpp in _FLOAT_TYPES:
+        if math.isnan(value):
+            return "NaN"
+        if math.isinf(value):
+            return "Infinity" if value > 0 else "-Infinity"
+        return value
+    if cpp in _WIDE_TYPES and opts.int64_as_string:
+        return str(value)
+    if cpp == _FD.CPPTYPE_STRING:
+        if field.type == _FD.TYPE_BYTES:
+            if opts.bytes_to_base64:
+                return base64.b64encode(value).decode("ascii")
+            return value.decode("latin-1")
+        return value
+    return value
+
+
+def _repeated(field) -> bool:
+    # protobuf >=5.30 exposes is_repeated as an attribute; older versions
+    # only have .label (deprecated but functional)
+    rep = getattr(field, "is_repeated", None)
+    if isinstance(rep, bool):
+        return rep
+    return field.label == _FD.LABEL_REPEATED
+
+
+def _is_map_field(field) -> bool:
+    return (_repeated(field)
+            and field.cpp_type == _FD.CPPTYPE_MESSAGE
+            and field.message_type.GetOptions().map_entry)
+
+
+def _message_to_dict(msg, opts: Pb2JsonOptions) -> dict:
+    out = {}
+    for field in msg.DESCRIPTOR.fields:
+        name = field.name
+        if _is_map_field(field):
+            mapping = getattr(msg, name)
+            if not mapping and not opts.jsonify_empty_array:
+                continue
+            vfield = field.message_type.fields_by_name["value"]
+            out[name] = {str(k).lower() if isinstance(k, bool) else str(k):
+                         _value_to_json(vfield, v, opts)
+                         for k, v in sorted(mapping.items(),
+                                            key=lambda kv: str(kv[0]))}
+            continue
+        if _repeated(field):
+            items = getattr(msg, name)
+            if not items and not opts.jsonify_empty_array:
+                continue
+            out[name] = [_value_to_json(field, v, opts) for v in items]
+            continue
+        if field.cpp_type == _FD.CPPTYPE_MESSAGE:
+            if msg.HasField(name):
+                out[name] = _message_to_dict(getattr(msg, name), opts)
+            continue
+        if field.containing_oneof is not None:
+            if msg.HasField(name):
+                out[name] = _value_to_json(field, getattr(msg, name), opts)
+            continue
+        value = getattr(msg, name)
+        if value == field.default_value \
+                and not opts.always_print_primitive_fields:
+            continue
+        out[name] = _value_to_json(field, value, opts)
+    return out
+
+
+def pb_to_json(message, pretty: bool = False,
+               always_print_fields_with_no_presence: bool = False,
+               options: Optional[Pb2JsonOptions] = None) -> str:
+    opts = options or Pb2JsonOptions(
+        pretty=pretty,
+        always_print_primitive_fields=always_print_fields_with_no_presence)
+    try:
+        d = _message_to_dict(message, opts)
+        return json.dumps(d, indent=2 if opts.pretty else None,
+                          sort_keys=False)
+    except Json2PbError:
+        raise
+    except Exception as e:
+        raise Json2PbError(str(e)) from None
+
+
+# ------------------------------------------------------------------ json->pb
+def _json_to_value(field, value, opts: Json2PbOptions, where: str):
+    cpp = field.cpp_type
+    if cpp == _FD.CPPTYPE_ENUM:
+        if isinstance(value, str):
+            ev = field.enum_type.values_by_name.get(value)
+            if ev is None:
+                if opts.allow_unknown_enum:
+                    return None
+                raise Json2PbError(f"{where}: unknown enum name {value!r}")
+            return ev.number
+        if isinstance(value, bool) or not isinstance(value, int):
+            raise Json2PbError(f"{where}: bad enum value {value!r}")
+        return value
+    if cpp == _FD.CPPTYPE_BOOL:
+        if isinstance(value, bool):
+            return value
+        raise Json2PbError(f"{where}: expected bool, got {value!r}")
+    if cpp in _INT_TYPES:
+        if isinstance(value, bool) or not isinstance(value, (int, str)):
+            raise Json2PbError(f"{where}: expected int, got {value!r}")
+        try:
+            return int(value)
+        except ValueError:
+            raise Json2PbError(f"{where}: bad int {value!r}") from None
+    if cpp in _FLOAT_TYPES:
+        if isinstance(value, str):
+            if value == "NaN":
+                return math.nan
+            if value == "Infinity":
+                return math.inf
+            if value == "-Infinity":
+                return -math.inf
+            try:
+                return float(value)
+            except ValueError:
+                raise Json2PbError(f"{where}: bad float {value!r}") from None
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            return float(value)
+        raise Json2PbError(f"{where}: expected number, got {value!r}")
+    if cpp == _FD.CPPTYPE_STRING:
+        if field.type == _FD.TYPE_BYTES:
+            if not isinstance(value, str):
+                raise Json2PbError(f"{where}: expected string for bytes")
+            if opts.base64_to_bytes:
+                try:
+                    return base64.b64decode(value, validate=True)
+                except Exception:
+                    raise Json2PbError(
+                        f"{where}: invalid base64") from None
+            return value.encode("latin-1")
+        if not isinstance(value, str):
+            raise Json2PbError(f"{where}: expected string, got {value!r}")
+        return value
+    raise Json2PbError(f"{where}: unhandled field type")
+
+
+def _map_key_from_json(kfield, key: str, where: str):
+    cpp = kfield.cpp_type
+    if cpp == _FD.CPPTYPE_BOOL:
+        if key in ("true", "false"):
+            return key == "true"
+        raise Json2PbError(f"{where}: bad bool map key {key!r}")
+    if cpp in _INT_TYPES:
+        try:
+            return int(key)
+        except ValueError:
+            raise Json2PbError(f"{where}: bad int map key {key!r}") from None
+    return key
+
+
+def _dict_to_message(d: dict, msg, opts: Json2PbOptions, where: str) -> None:
+    if not isinstance(d, dict):
+        raise Json2PbError(f"{where}: expected object, got {d!r}")
+    fields = msg.DESCRIPTOR.fields_by_name
+    for key, value in d.items():
+        field = fields.get(key)
+        if field is None:
+            # also accept camelCase against snake_case schemas
+            field = next((f for f in msg.DESCRIPTOR.fields
+                          if f.json_name == key), None)
+        if field is None:
+            if opts.ignore_unknown_fields:
+                continue
+            raise Json2PbError(f"{where}: unknown field {key!r}")
+        fwhere = f"{where}.{field.name}"
+        if value is None:
+            continue  # JSON null = leave default (proto3 json mapping)
+        if _is_map_field(field):
+            if not isinstance(value, dict):
+                raise Json2PbError(f"{fwhere}: expected object for map")
+            kfield = field.message_type.fields_by_name["key"]
+            vfield = field.message_type.fields_by_name["value"]
+            target = getattr(msg, field.name)
+            for k, v in value.items():
+                pk = _map_key_from_json(kfield, k, fwhere)
+                if vfield.cpp_type == _FD.CPPTYPE_MESSAGE:
+                    _dict_to_message(v, target[pk], opts, f"{fwhere}[{k}]")
+                else:
+                    converted = _json_to_value(vfield, v, opts,
+                                               f"{fwhere}[{k}]")
+                    if converted is not None:
+                        target[pk] = converted
+            continue
+        if _repeated(field):
+            if not isinstance(value, list):
+                raise Json2PbError(f"{fwhere}: expected array")
+            target = getattr(msg, field.name)
+            for i, item in enumerate(value):
+                iw = f"{fwhere}[{i}]"
+                if field.cpp_type == _FD.CPPTYPE_MESSAGE:
+                    _dict_to_message(item, target.add(), opts, iw)
+                else:
+                    converted = _json_to_value(field, item, opts, iw)
+                    if converted is not None:
+                        target.append(converted)
+            continue
+        if field.cpp_type == _FD.CPPTYPE_MESSAGE:
+            _dict_to_message(value, getattr(msg, field.name), opts, fwhere)
+            continue
+        converted = _json_to_value(field, value, opts, fwhere)
+        if converted is not None:
+            setattr(msg, field.name, converted)
+
+
+def json_to_pb(data, message_class: Type,
+               ignore_unknown_fields: bool = True,
+               options: Optional[Json2PbOptions] = None):
     """Parse a JSON document (str/bytes) into a new message instance."""
+    opts = options or Json2PbOptions(
+        ignore_unknown_fields=ignore_unknown_fields)
     if isinstance(data, (bytes, bytearray, memoryview)):
-        data = bytes(data).decode("utf-8", errors="strict")
+        try:
+            data = bytes(data).decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise Json2PbError(str(e)) from None
     msg = message_class()
     if data.strip() == "":
         return msg  # empty body = default message (GET-style calls)
     try:
-        json_format.Parse(data, msg,
-                          ignore_unknown_fields=ignore_unknown_fields)
-    except (json_format.ParseError, UnicodeDecodeError) as e:
+        parsed = json.loads(data)
+    except json.JSONDecodeError as e:
+        raise Json2PbError(str(e)) from None
+    try:
+        _dict_to_message(parsed, msg, opts, message_class.DESCRIPTOR.name)
+    except Json2PbError:
+        raise
+    except ValueError as e:
+        # protobuf setattr range checks (int32 overflow, negative uint...)
         raise Json2PbError(str(e)) from None
     return msg
-
-
-def pb_to_json(message, pretty: bool = False,
-               always_print_fields_with_no_presence: bool = False) -> str:
-    try:
-        return json_format.MessageToJson(
-            message,
-            indent=2 if pretty else None,
-            preserving_proto_field_name=True,
-            always_print_fields_with_no_presence=(
-                always_print_fields_with_no_presence),
-        )
-    except Exception as e:
-        raise Json2PbError(str(e)) from None

@@ -16,7 +16,6 @@ from brpc_tpu.metrics.latency_recorder import IntRecorder, LatencyRecorder
 from brpc_tpu.metrics.status import (
     Status,
     PassiveStatus,
-    MultiDimension,
     prometheus_text,
 )
 
@@ -46,3 +45,4 @@ __all__ = [
     "MultiDimension",
     "prometheus_text",
 ]
+from brpc_tpu.metrics.multi_dimension import MultiDimension  # noqa: E402,F401

@@ -1,4 +1,4 @@
-"""Status / PassiveStatus / MultiDimension / prometheus exposition.
+"""Status / PassiveStatus / prometheus exposition.
 
 Rebuilds bvar's gauge family: Status (set-once-read-many gauge,
 ``bvar/status.h``), PassiveStatus (callback-backed gauge,
@@ -9,10 +9,9 @@ Rebuilds bvar's gauge family: Status (set-once-read-many gauge,
 
 from __future__ import annotations
 
-import threading
-from typing import Callable, Dict, Tuple
+from typing import Callable
 
-from brpc_tpu.metrics.variable import Variable, dump_exposed
+from brpc_tpu.metrics.variable import Variable
 
 
 class Status(Variable):
@@ -40,43 +39,35 @@ class PassiveStatus(Variable):
         return self._fn()
 
 
-class MultiDimension(Variable):
-    """Labeled metric family: get_stats(labels) -> per-combination variable."""
-
-    def __init__(self, label_names: Tuple[str, ...], factory=None):
-        super().__init__()
-        self.label_names = tuple(label_names)
-        self._factory = factory or (lambda: Status(0))
-        self._stats: Dict[Tuple[str, ...], Variable] = {}
-        self._lock = threading.Lock()
-
-    def get_stats(self, labels: Tuple[str, ...]) -> Variable:
-        labels = tuple(labels)
-        if len(labels) != len(self.label_names):
-            raise ValueError("label arity mismatch")
-        with self._lock:
-            var = self._stats.get(labels)
-            if var is None:
-                var = self._factory()
-                self._stats[labels] = var
-            return var
-
-    def get_value(self):
-        with self._lock:
-            return {k: v.get_value() for k, v in self._stats.items()}
-
-    def count_stats(self) -> int:
-        with self._lock:
-            return len(self._stats)
+def _escape_label(v: str) -> str:
+    # exposition format: backslash, quote, newline must be escaped
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
 
 
 def prometheus_text() -> str:
-    """Render every exposed variable in Prometheus exposition format."""
+    """Render every exposed variable in Prometheus exposition format.
+    MultiDimension families render one labeled sample per combination
+    (reference builtin/prometheus_metrics_service.cpp)."""
+    from brpc_tpu.metrics.variable import exposed_variables
+
     lines = []
-    for name, value in dump_exposed().items():
+    for name, var in exposed_variables():
         metric = name.replace(".", "_").replace("-", "_")
+        samples = getattr(var, "prometheus_samples", None)
+        if samples is not None:
+            rendered = False
+            for labels, num in samples():
+                if not rendered:
+                    lines.append(f"# TYPE {metric} gauge")
+                    rendered = True
+                lbl = ",".join(
+                    f'{k}="{_escape_label(v)}"'
+                    for k, v in sorted(labels.items()))
+                lines.append(f"{metric}{{{lbl}}} {num:g}")
+            continue
         try:
-            num = float(value)
+            num = float(var.describe())
         except (TypeError, ValueError):
             continue  # prometheus only carries numeric samples
         lines.append(f"# TYPE {metric} gauge")

@@ -75,6 +75,13 @@ def list_exposed() -> List[str]:
         return sorted(_registry)
 
 
+def exposed_variables():
+    """Sorted (name, Variable) snapshot (labeled families need the object,
+    not just the describe() string)."""
+    with _registry_lock:
+        return sorted(_registry.items())
+
+
 def dump_exposed() -> Dict[str, str]:
     """Snapshot of every exposed variable (for /vars and file dumps)."""
     with _registry_lock:

@@ -24,6 +24,7 @@ def ensure_registered() -> None:
         from brpc_tpu.policy.http_protocol import HttpProtocol
         from brpc_tpu.policy.grpc_protocol import GrpcProtocol
 
+        from brpc_tpu.policy.mongo_protocol import MongoProtocol
         from brpc_tpu.policy.redis_protocol import RedisProtocol
         from brpc_tpu.policy.thrift_protocol import ThriftProtocol
         from brpc_tpu.policy.memcache import MemcacheProtocol
@@ -40,6 +41,7 @@ def ensure_registered() -> None:
         # otherwise parse as an HTTP/1 request-line
         register_protocol(GrpcProtocol())
         register_protocol(RedisProtocol())
+        register_protocol(MongoProtocol())
         register_protocol(ThriftProtocol())
         register_protocol(MemcacheProtocol())
         register_protocol(NsheadProtocol())

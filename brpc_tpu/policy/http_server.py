@@ -114,6 +114,16 @@ def process_http_request(msg, server) -> None:
 
     if not server.is_running:
         return reject(errors.ELOGOFF, errors.error_text(errors.ELOGOFF))
+    if server.options.interceptor is not None:
+        # the global hook covers the HTTP RPC lane too (same semantics as
+        # process_rpc_request; builtin dashboard paths are not RPCs)
+        try:
+            verdict = server.options.interceptor(cntl)
+        except Exception as e:
+            verdict = (errors.EINTERNAL, f"interceptor raised: {e}")
+        if verdict is not None:
+            return reject(int(verdict[0]),
+                          verdict[1] if len(verdict) > 1 else "")
     if not server.add_concurrency():
         return reject(errors.ELIMIT, "server max_concurrency reached")
     start_us = time.perf_counter_ns() // 1000

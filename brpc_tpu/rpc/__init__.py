@@ -1,9 +1,12 @@
 """rpc — the transport & RPC engine (SURVEY §2.4)."""
 
 from brpc_tpu.rpc import errors
-from brpc_tpu.rpc.channel import Channel, ChannelOptions, MethodDescriptor, RpcError, Stub
+from brpc_tpu.rpc.channel import (Channel, ChannelOptions,
+                                  MethodDescriptor, RawMessage, RpcError,
+                                  Stub)
 from brpc_tpu.rpc.controller import Controller
-from brpc_tpu.rpc.server import Server, ServerOptions, Service
+from brpc_tpu.rpc.server import (GenericService, Server, ServerOptions,
+                                 Service)
 from brpc_tpu.rpc.socket import Socket
 from brpc_tpu.rpc.event_dispatcher import EventDispatcher, global_dispatcher
 from brpc_tpu.rpc.input_messenger import InputMessenger
@@ -15,10 +18,12 @@ __all__ = [
     "MethodDescriptor",
     "RpcError",
     "Stub",
+    "RawMessage",
     "Controller",
     "Server",
     "ServerOptions",
     "Service",
+    "GenericService",
     "Socket",
     "EventDispatcher",
     "global_dispatcher",

@@ -64,6 +64,24 @@ class Service:
         return self._methods.get(name)
 
 
+class GenericService(Service):
+    """Base for master services (reference baidu_master_service.cpp):
+    implement ``Process(cntl, request, done)`` where ``request`` is a
+    RawMessage holding the untouched serialized request bytes; return (or
+    pass to ``done``) a RawMessage with the serialized response. The
+    original service/method names are on ``cntl.service_name`` /
+    ``cntl.method_name`` — everything a transparent proxy needs."""
+
+    def __init__(self):
+        super().__init__()
+        from brpc_tpu.rpc.channel import RawMessage
+
+        self.add_method("*", self.Process, RawMessage, RawMessage)
+
+    def Process(self, cntl, request, done):
+        raise NotImplementedError
+
+
 @dataclass
 class MethodEntry:
     name: str
@@ -120,6 +138,7 @@ class ServerOptions:
     idle_timeout_s: int = -1
     rpc_dump_dir: Optional[str] = None  # sample requests here (rpc_dump)
     redis_service: object = None      # policy/redis_protocol.RedisService
+    mongo_service: object = None      # policy/mongo_protocol.MongoService
     thrift_service: object = None     # policy/thrift_protocol.ThriftService
     nshead_service: object = None     # policy/nshead.NsheadService
     # serve TRPC traffic through the C++ engine (epoll + frame cutting in
@@ -131,6 +150,13 @@ class ServerOptions:
     # keeps serving plaintext: the first byte of each connection is sniffed
     # (0x16 = TLS) before wrapping, like the reference single-port design.
     ssl: object = None
+    # global request interception hook (reference interceptor.h / server.h
+    # :98-105): called with the server Controller BEFORE dispatch; return
+    # None to accept, or (error_code, error_text) to reject. Covers the pb
+    # RPC lanes (trpc_std, grpc, http); byte-service protocols with their
+    # own handler registries (redis/mongo/thrift/nshead services) bypass pb
+    # dispatch entirely and enforce their own admission.
+    interceptor: object = None
 
 
 class Server:
@@ -155,6 +181,7 @@ class Server:
         self._native_dp = None
         self._native_echoes = []        # (service, method) C++ fast paths
         self._ssl_ctx = None            # built lazily from options.ssl
+        self._master_service = None     # catch-all generic service
         self.rpc_dumper = None
         if self.options.rpc_dump_dir:
             from brpc_tpu.trace.rpc_dump import RpcDumper
@@ -162,6 +189,17 @@ class Server:
             self.rpc_dumper = RpcDumper(self.options.rpc_dump_dir)
 
     # -------------------------------------------------------------- services
+    def set_master_service(self, service: "Service") -> "Server":
+        """Catch-all untyped service (reference baidu_master_service.cpp):
+        receives every request whose service/method is not registered, as
+        RawMessage byte bags — the generic-proxy building block. The
+        service must expose a ``*`` method (subclass GenericService)."""
+        if service.find_method("*") is None:
+            raise ValueError("master service must define method '*' "
+                             "(subclass GenericService)")
+        self._master_service = service
+        return self
+
     def add_service(self, service: Service) -> "Server":
         name = service.service_name
         if name in self._services:

@@ -58,6 +58,16 @@ def process_rpc_request(protocol, msg, server) -> None:
             err = (errors.EAUTH, "")
         else:
             cntl.auth_context = auth_ctx
+        if err is None and server.options.interceptor is not None:
+            # global interception hook (reference interceptor.h Accept):
+            # None = accept; (code, text) = reject before dispatch
+            try:
+                verdict = server.options.interceptor(cntl)
+            except Exception as e:
+                verdict = (errors.EINTERNAL, f"interceptor raised: {e}")
+            if verdict is not None:
+                err = (int(verdict[0]),
+                       verdict[1] if len(verdict) > 1 else "")
         if err is None:
             service = server.find_service(meta.request.service_name)
             if service is None:
@@ -71,6 +81,18 @@ def process_rpc_request(protocol, msg, server) -> None:
                 elif not entry.on_request():
                     entry = None
                     err = (errors.ELIMIT, "method concurrency limit")
+            if entry is None and server._master_service is not None \
+                    and err[0] in (errors.ENOSERVICE, errors.ENOMETHOD):
+                # catch-all generic service takes UNMATCHED requests only
+                # (reference baidu_master_service.cpp) — a known method shed
+                # by its concurrency limit must stay ELIMIT, not get
+                # re-executed by the proxy
+                entry = server._master_service.find_method("*")
+                if entry.on_request():
+                    err = None
+                else:
+                    entry = None
+                    err = (errors.ELIMIT, "master service concurrency limit")
     except BaseException:
         server.sub_concurrency()
         raise

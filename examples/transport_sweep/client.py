@@ -1,0 +1,93 @@
+"""Payload sweep benchmark client — the rdma_performance analog
+(reference example/rdma_performance/client.cpp:254-266 prints MB/s +
+windowed latency percentiles per payload size).
+
+Server side: any echo server, e.g.
+    python tools/bench_server.py --listen 127.0.0.1:8001 [--native]
+Then:
+    python examples/transport_sweep/client.py --server 127.0.0.1:8001 \
+        [--sizes 64,4096,65536,1048576] [--threads 4] [--attachment]
+"""
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from brpc_tpu.proto import echo_pb2  # noqa: E402
+from brpc_tpu.rpc import (Channel, ChannelOptions, Controller,  # noqa: E402
+                          Stub)
+
+
+def percentile(lat, p):
+    return lat[min(len(lat) - 1, int(p * len(lat)))] if lat else 0.0
+
+
+def run_size(stub, size, threads, seconds, use_attachment):
+    payload = b"\xab" * size
+    stop = threading.Event()
+    lats = [[] for _ in range(threads)]
+
+    def worker(idx):
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            if use_attachment:
+                cntl = Controller()
+                cntl.request_attachment = payload
+                stub.Echo(echo_pb2.EchoRequest(message="s"), controller=cntl)
+                assert len(cntl.response_attachment) == size
+            else:
+                r = stub.Echo(echo_pb2.EchoRequest(message="s",
+                                                   payload=payload))
+                assert len(r.payload) == size
+            lats[idx].append(time.perf_counter() - t0)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in ts:
+        t.join()
+    wall = time.perf_counter() - t0
+    lat = sorted(x for lst in lats for x in lst)
+    n = len(lat)
+    mbps = 2 * size * n / wall / 1e6
+    print(f"{size:>9}B  {mbps:10.1f} MB/s  qps={n / wall:9,.0f}  "
+          f"avg={sum(lat) / n * 1e6:8.0f}us  "
+          f"p90={percentile(lat, 0.90) * 1e6:8.0f}us  "
+          f"p99={percentile(lat, 0.99) * 1e6:8.0f}us  "
+          f"p999={percentile(lat, 0.999) * 1e6:8.0f}us")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--server", default="127.0.0.1:8001")
+    ap.add_argument("--sizes", default="64,4096,65536,1048576")
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--attachment", action="store_true",
+                    help="carry the payload as an attachment (skips pb "
+                         "serialization — the bulk-data lane)")
+    ap.add_argument("--native", action="store_true",
+                    help="use the C++ engine client transport")
+    args = ap.parse_args(argv)
+    ch = Channel(ChannelOptions(protocol="trpc_std", timeout_ms=60000,
+                                native_transport=args.native))
+    ch.init(args.server)
+    stub = Stub(ch, echo_pb2.DESCRIPTOR.services_by_name["EchoService"])
+    stub.Echo(echo_pb2.EchoRequest(message="warmup"))
+    print(f"# sweep against {args.server} threads={args.threads} "
+          f"attachment={args.attachment} native={args.native}")
+    for size in (int(s) for s in args.sizes.split(",")):
+        run_size(stub, size, args.threads, args.seconds, args.attachment)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -104,3 +104,28 @@ class TestSingleFileExamples:
         out = run_single("examples/tpu_transfer/client.py",
                          ["--sizes", "4096,65536", "-n", "4"])
         assert "MB/s" in out
+
+    def test_transport_sweep(self):
+        # bench_server prints LISTEN and serves until stdin closes
+        srv = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tools", "bench_server.py"),
+             "--listen", "127.0.0.1:0", "--native"],
+            env=ENV, stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        try:
+            addr = srv.stdout.readline().split(" ", 1)[1].strip()
+            client = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "examples", "transport_sweep",
+                              "client.py"),
+                 "--server", addr, "--sizes", "64,65536", "--threads", "2",
+                 "--seconds", "0.5", "--attachment", "--native"],
+                env=ENV, capture_output=True, text=True, timeout=60)
+            assert client.returncode == 0, client.stdout + client.stderr
+            assert "MB/s" in client.stdout and "p99=" in client.stdout
+        finally:
+            srv.stdin.close()
+            try:
+                srv.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                srv.kill()
+                srv.wait()

@@ -247,7 +247,21 @@ class TestMultiDimension:
         md.get_stats(("echo", "200")).set_value(5)
         md.get_stats(("echo", "500")).set_value(1)
         assert md.count_stats() == 2
-        assert md.get_value()[("echo", "200")] == 5
+        assert md.get_stats(("echo", "200")).get_value() == 5
+        assert md.has_stats(("echo", "500"))
+        md.delete_stats(("echo", "500"))
+        assert md.count_stats() == 1
+
+    def test_factory_form_and_prometheus_labels(self):
+        from brpc_tpu.metrics import Adder
+        from brpc_tpu.metrics.status import prometheus_text
+
+        md = MultiDimension(Adder, ["svc"]).expose("md_prom_test")
+        md.stats(["a"]).put(2)
+        md.stats(["b"]).put(7)
+        text = prometheus_text()
+        assert 'md_prom_test{svc="a"} 2' in text
+        assert 'md_prom_test{svc="b"} 7' in text
 
     def test_arity_check(self):
         md = MultiDimension(("a",))

@@ -210,6 +210,18 @@ def seeds_nshead():
             NsheadMessage(b"", id=3, version=1).SerializeToString()]
 
 
+def seeds_mongo():
+    from brpc_tpu.policy.mongo_protocol import pack_msg
+
+    return [
+        pack_msg(1, 0, {"ping": 1, "$db": "admin"}),
+        pack_msg(2, 1, {"ok": 1.0, "cursor": {"id": 0,
+                                              "firstBatch": [{"a": 1}]}}),
+        pack_msg(3, 0, {"insert": "c", "documents": [
+            {"x": [1, None, "s"], "b": b"\x00\x01"}]}),
+    ]
+
+
 def seeds_thrift():
     from brpc_tpu.policy.thrift_protocol import pack_message
 
@@ -305,6 +317,20 @@ def target_nshead(data: bytes) -> None:
     NsheadProtocol().parse(IOBuf(data), _FakeSock())
 
 
+def target_mongo(data: bytes) -> None:
+    from brpc_tpu.policy.mongo_protocol import MongoProtocol
+
+    sock = _FakeSock()
+    sock.mongo_server = True  # route past the ownership probe
+    MongoProtocol().parse(IOBuf(data), sock)
+
+
+def target_bson(data: bytes) -> None:
+    from brpc_tpu.policy import bson
+
+    bson.decode(data)
+
+
 def target_thrift(data: bytes) -> None:
     from brpc_tpu.policy.thrift_protocol import ThriftProtocol
 
@@ -313,6 +339,12 @@ def target_thrift(data: bytes) -> None:
 
 class unavailable(Exception):
     pass
+
+
+def _bson_error():
+    from brpc_tpu.policy.bson import BsonError
+
+    return BsonError
 
 
 def _allowed():
@@ -330,6 +362,10 @@ def _allowed():
         "memcache": (target_memcache, seeds_memcache, ()),
         "nshead": (target_nshead, seeds_nshead, ()),
         "thrift": (target_thrift, seeds_thrift, ()),
+        "mongo": (target_mongo, seeds_mongo, ()),
+        "bson": (target_bson,
+                 lambda: [s[21:] for s in seeds_mongo()],  # raw body docs
+                 (_bson_error(),)),
     }
 
 
