@@ -89,6 +89,46 @@ def vars_service(server, http: HttpMessage):
     return 200, CONTENT_TEXT, body
 
 
+# ----------------------------------------------------------------------- vlog
+def vlog_service(server, http: HttpMessage):
+    """Verbose-log control (reference builtin/vlog_service.cpp), two planes:
+    VLOG sites (?setlevel=pattern=N) and python logger levels
+    (?logger=name&level=DEBUG)."""
+    import logging as _logging
+
+    from brpc_tpu.butil import vlog as _vlog
+
+    if "logger" in http.query:
+        name = http.query["logger"]
+        level_name = http.query.get("level", "")
+        level = _logging.getLevelName(level_name.upper())
+        if not isinstance(level, int):
+            return 400, CONTENT_TEXT, f"bad level {level_name!r}\n"
+        _logging.getLogger(name).setLevel(level)
+        return 200, CONTENT_TEXT, f"{name} -> {level_name.upper()}\n"
+    if "setlevel" in http.query:
+        spec = http.query["setlevel"]
+        pattern, _, level = spec.rpartition("=")
+        if not pattern:
+            return 400, CONTENT_TEXT, "setlevel wants pattern=level\n"
+        try:
+            n = _vlog.set_vlevel(pattern, int(level))
+        except ValueError:
+            return 400, CONTENT_TEXT, f"bad level {level!r}\n"
+        return 200, CONTENT_TEXT, f"{pattern} -> {level} ({n} modules)\n"
+    lines = ["== vlog sites (setlevel=pattern=N) =="]
+    lines += [f"{m}={lv}  (sites up to v{seen})"
+              for m, lv, seen in _vlog.dump()] or ["(none yet)"]
+    lines.append("")
+    lines.append("== python loggers (logger=name&level=NAME) ==")
+    root = _logging.getLogger()
+    names = sorted(n for n in root.manager.loggerDict
+                   if n.startswith("brpc_tpu"))
+    lines += [f"{n}={_logging.getLevelName(_logging.getLogger(n).level)}"
+              for n in names]
+    return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
+
+
 # ---------------------------------------------------------------------- flags
 def flags_service(server, http: HttpMessage):
     name = _sub_path(http)
@@ -273,3 +313,5 @@ register_builtin("memory", memory_service, "process memory stats")
 register_builtin("ids", ids_service, "live call ids")
 register_builtin("rpcz", rpcz_service, "recent rpc spans (/rpcz/<trace_id>)")
 register_builtin("logoff", logoff_service, "stop accepting new requests")
+register_builtin("vlog", vlog_service,
+                 "verbose-log sites (/vlog?setlevel=module=N)")

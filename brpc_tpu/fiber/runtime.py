@@ -32,7 +32,7 @@ DEFAULT_TAG = 0
 
 
 class FiberTask:
-    __slots__ = ("fn", "args", "done", "error", "_event")
+    __slots__ = ("fn", "args", "done", "error", "_event", "keytable")
 
     def __init__(self, fn, args):
         self.fn = fn
@@ -40,13 +40,18 @@ class FiberTask:
         self.done = False
         self.error: Optional[BaseException] = None
         self._event = threading.Event()
+        self.keytable = None  # fiber-local storage (fiber/local.py)
 
     def run(self) -> None:
+        from brpc_tpu.fiber import local as _local
+
+        _local._enter_task(self)
         try:
             self.fn(*self.args)
         except BaseException as e:  # noqa: BLE001 - task errors are captured
             self.error = e
         finally:
+            _local._exit_task(self)
             self.done = True
             self._event.set()
 

@@ -1,0 +1,85 @@
+"""Collector — the shared, rate-limited sampling budget.
+
+Counterpart of the reference's bvar Collector (``bvar/collector.h``, used
+by rpc_dump's speed limiter ``rpc_dump.h:46-57``, span sampling and the
+contention profiler): every sampled subsystem draws grants from ONE
+process-wide token bucket, so the combined overhead of observability stays
+bounded no matter how many subsystems sample at once — a trace storm
+cannot multiply with a dump storm.
+
+Callers keep their own *selection* policy (ratio flags); the collector is
+the global budget behind them:
+
+    if ratio_ok and global_collector().ask_to_be_sampled():
+        ...record the sample...
+
+Budget: ``collector_max_samples_per_second`` (reloadable flag; <=0 turns
+the cap off). Grants/denies are exposed via /vars.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from brpc_tpu import flags as _flags
+from brpc_tpu.metrics.reducer import Adder
+
+collector_max_samples_per_second = _flags.define(
+    "collector_max_samples_per_second", 1000,
+    "process-wide budget shared by every sampling subsystem "
+    "(rpcz, rpc_dump, contention); <=0 disables the cap",
+    reloadable=True)
+
+
+class Collector:
+    def __init__(self, max_per_second: Optional[int] = None):
+        self._fixed_rate = max_per_second
+        self._lock = threading.Lock()
+        self._tokens = None  # primed to a full bucket on first ask
+        self._last_refill = time.monotonic()
+        self.grants = Adder()
+        self.denies = Adder()
+        self.grants.expose_as("collector_grants")
+        self.denies.expose_as("collector_denies")
+
+    def _rate(self) -> int:
+        if self._fixed_rate is not None:
+            return self._fixed_rate
+        return int(_flags.get("collector_max_samples_per_second"))
+
+    def ask_to_be_sampled(self, weight: int = 1) -> bool:
+        """Draw ``weight`` grants from the shared budget. True = sample."""
+        rate = self._rate()
+        if rate <= 0:
+            self.grants.put(weight)
+            return True  # cap disabled
+        now = time.monotonic()
+        with self._lock:
+            if self._tokens is None:
+                self._tokens = float(rate)  # full bucket at startup
+            elapsed = now - self._last_refill
+            if elapsed > 0:
+                self._tokens = min(float(rate),
+                                   self._tokens + elapsed * rate)
+                self._last_refill = now
+            if self._tokens >= weight:
+                self._tokens -= weight
+                granted = True
+            else:
+                granted = False
+        (self.grants if granted else self.denies).put(weight)
+        return granted
+
+
+_collector: Optional[Collector] = None
+_collector_lock = threading.Lock()
+
+
+def global_collector() -> Collector:
+    global _collector
+    with _collector_lock:
+        if _collector is None:
+            _collector = Collector()
+        return _collector

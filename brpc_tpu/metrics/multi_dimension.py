@@ -22,13 +22,25 @@ from brpc_tpu.metrics.variable import Variable
 
 
 class MultiDimension(Variable):
-    def __init__(self, factory=None, label_names: Sequence[str] = (),
-                 ):
+    def __init__(self, arg1=None, arg2=None):
+        """Accepted forms (both argument orders are unambiguous because a
+        factory is callable and label names are a sequence of strings):
+
+            MultiDimension(Adder, ["method", "status"])   # canonical
+            MultiDimension(["method", "status"], Adder)
+            MultiDimension(("method", "status"))          # Status default
+        """
         super().__init__()
-        # ergonomic forms: MultiDimension(Adder, ["a","b"]) — canonical —
-        # plus MultiDimension(("a","b")) with a Status default factory
-        if factory is not None and not callable(factory) and not label_names:
-            factory, label_names = None, factory
+        if callable(arg1) and not isinstance(arg1, (list, tuple)):
+            factory, label_names = arg1, arg2
+        elif isinstance(arg1, (list, tuple)):
+            label_names, factory = arg1, arg2
+            if factory is not None and not callable(factory):
+                raise TypeError(f"factory must be callable, got {factory!r}")
+        else:
+            raise TypeError(
+                "MultiDimension wants (factory, label_names) or "
+                f"(label_names[, factory]); got {arg1!r}, {arg2!r}")
         if factory is None:
             from brpc_tpu.metrics.status import Status
 

@@ -70,8 +70,10 @@ def _encode_value(key: str, value, out: bytearray) -> None:
     elif isinstance(value, int):
         if -(1 << 31) <= value < (1 << 31):
             out += b"\x10" + kb + struct.pack("<i", value)
-        else:
+        elif -(1 << 63) <= value < (1 << 63):
             out += b"\x12" + kb + struct.pack("<q", value)
+        else:
+            raise BsonError(f"int out of int64 range: {value}")
     elif isinstance(value, str):
         vb = value.encode("utf-8") + b"\x00"
         out += b"\x02" + kb + struct.pack("<i", len(vb)) + vb
@@ -86,6 +88,8 @@ def _encode_value(key: str, value, out: bytearray) -> None:
     elif isinstance(value, ObjectId):
         out += b"\x07" + kb + value.binary
     elif isinstance(value, _dt.datetime):
+        if value.tzinfo is None:  # the common naive idiom means UTC
+            value = value.replace(tzinfo=_dt.timezone.utc)
         ms = int((value - _EPOCH).total_seconds() * 1000)
         out += b"\x09" + kb + struct.pack("<q", ms)
     elif value is None:

@@ -114,16 +114,6 @@ def process_http_request(msg, server) -> None:
 
     if not server.is_running:
         return reject(errors.ELOGOFF, errors.error_text(errors.ELOGOFF))
-    if server.options.interceptor is not None:
-        # the global hook covers the HTTP RPC lane too (same semantics as
-        # process_rpc_request; builtin dashboard paths are not RPCs)
-        try:
-            verdict = server.options.interceptor(cntl)
-        except Exception as e:
-            verdict = (errors.EINTERNAL, f"interceptor raised: {e}")
-        if verdict is not None:
-            return reject(int(verdict[0]),
-                          verdict[1] if len(verdict) > 1 else "")
     if not server.add_concurrency():
         return reject(errors.ELIMIT, "server max_concurrency reached")
     start_us = time.perf_counter_ns() // 1000
@@ -139,6 +129,13 @@ def process_http_request(msg, server) -> None:
             err = (errors.EAUTH, errors.error_text(errors.EAUTH))
         else:
             cntl.auth_context = auth_ctx
+            # global hook, HTTP RPC lane — after auth like the binary lane
+            # (process_rpc_request), so cntl.auth_context is populated
+            if err is None and server.options.interceptor is not None:
+                from brpc_tpu.rpc.server_processing import run_interceptor
+
+                err = run_interceptor(server, cntl)
+        if err is None:
             service = server.find_service(service_name)
             if service is None:
                 err = (errors.ENOSERVICE, f"no service {service_name!r}")

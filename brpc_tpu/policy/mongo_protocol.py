@@ -63,10 +63,14 @@ def unpack_msg_body(body: bytes) -> dict:
     if len(body) < 5:
         raise bson.BsonError("OP_MSG body too short")
     # flagBits(4) + section kind byte; only kind 0 (single document) is
-    # accepted — kind 1 document sequences are a server-side niche
+    # accepted — and nothing may FOLLOW it (silently dropping a kind-1
+    # document sequence would lose payload, e.g. a driver's insert docs)
     if body[4] != 0:
         raise bson.BsonError(f"unsupported OP_MSG section kind {body[4]}")
-    return bson.decode(body[5:])
+    doc, end = bson._decode_doc(bytes(body), 5)
+    if end != len(body):
+        raise bson.BsonError("unsupported extra OP_MSG sections")
+    return doc
 
 
 class MongoRequest:
@@ -232,8 +236,15 @@ class MongoProtocol(Protocol):
                 reply = service.handle(doc)
             except bson.BsonError as e:
                 reply = {"ok": 0.0, "errmsg": f"bad BSON: {e}", "code": 22}
-            sock.write(IOBuf(pack_msg(_fresh_request_id(), request_id,
-                                      reply)))
+            try:
+                packet = pack_msg(_fresh_request_id(), request_id, reply)
+            except Exception as e:
+                # a handler returning something unencodable must still get
+                # SOME reply out — a swallowed exception hangs the client
+                packet = pack_msg(_fresh_request_id(), request_id,
+                                  {"ok": 0.0, "code": 8,
+                                   "errmsg": f"unencodable reply: {e}"})
+            sock.write(IOBuf(packet))
 
         runtime.start_background(work)
         return PARSE_NOT_ENOUGH_DATA, None
@@ -251,6 +262,11 @@ class MongoProtocol(Protocol):
                              OP_MSG) + payload
         with cst.lock:
             cst.inflight[rid] = (meta.correlation_id, meta.attempt_version)
+            if len(cst.inflight) > 4096:
+                # timed-out calls never get a reply to clear their entry;
+                # shed oldest first (stale late replies are rejected by the
+                # call-id version check anyway)
+                cst.inflight.pop(next(iter(cst.inflight)))
         rc = sock.write(IOBuf(packet), id_wait=id_wait)
         if rc != 0:
             with cst.lock:

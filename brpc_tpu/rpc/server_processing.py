@@ -20,6 +20,21 @@ from brpc_tpu.rpc import errors
 from brpc_tpu.rpc.controller import Controller
 
 
+def run_interceptor(server, cntl):
+    """Global interception hook (reference interceptor.h Accept): returns
+    None to accept or an (error_code, error_text) reject tuple. A hook
+    that raises OR returns a malformed verdict rejects with EINTERNAL —
+    it must never leave the request unanswered."""
+    try:
+        verdict = server.options.interceptor(cntl)
+        if verdict is None:
+            return None
+        return (int(verdict[0]),
+                str(verdict[1]) if len(verdict) > 1 else "")
+    except Exception as e:
+        return (errors.EINTERNAL, f"interceptor error: {e}")
+
+
 def process_rpc_request(protocol, msg, server) -> None:
     meta = msg.meta
     sock = msg.socket
@@ -59,15 +74,7 @@ def process_rpc_request(protocol, msg, server) -> None:
         else:
             cntl.auth_context = auth_ctx
         if err is None and server.options.interceptor is not None:
-            # global interception hook (reference interceptor.h Accept):
-            # None = accept; (code, text) = reject before dispatch
-            try:
-                verdict = server.options.interceptor(cntl)
-            except Exception as e:
-                verdict = (errors.EINTERNAL, f"interceptor raised: {e}")
-            if verdict is not None:
-                err = (int(verdict[0]),
-                       verdict[1] if len(verdict) > 1 else "")
+            err = run_interceptor(server, cntl)
         if err is None:
             service = server.find_service(meta.request.service_name)
             if service is None:

@@ -45,7 +45,13 @@ class RpcDumper:
         ratio = _flags.get("rpc_dump_ratio")
         if ratio <= 0.0:
             return False
-        return ratio >= 1.0 or random.random() < ratio
+        if ratio < 1.0 and random.random() >= ratio:
+            return False
+        # ratio selects; the shared Collector budget caps (reference
+        # rpc_dump.h:46-57 speed-limit via bvar Collector)
+        from brpc_tpu.metrics.collector import global_collector
+
+        return global_collector().ask_to_be_sampled()
 
     def sample(self, meta: rpc_meta_pb2.RpcMeta, body: bytes) -> None:
         record = pack_record(meta, body)

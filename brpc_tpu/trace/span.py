@@ -152,7 +152,13 @@ def _gen_id() -> int:
 
 def _sampled() -> bool:
     ratio = _flags.get("rpcz_sample_ratio")
-    return ratio >= 1.0 or random.random() < ratio
+    if ratio < 1.0 and random.random() >= ratio:
+        return False
+    # the selection ratio rides the PROCESS-WIDE sampling budget shared
+    # with rpc_dump etc. (metrics/collector.py, reference bvar Collector)
+    from brpc_tpu.metrics.collector import global_collector
+
+    return global_collector().ask_to_be_sampled()
 
 
 def start_client_span(service: str, method: str,
