@@ -217,6 +217,39 @@ def bench_native_lane():
         srv.close()
 
 
+def bench_native_tpu_lane():
+    """The graft's native lane: TPUC shm tunnel (RDMA-endpoint analog)
+    with both endpoints in the C++ engine — the rdma_performance analog
+    with no kernel socket in the payload path."""
+    from brpc_tpu.rpc.native_transport import (bench_echo_native,
+                                               dataplane_available)
+
+    if not dataplane_available():
+        return None
+    srv = _BenchServer("tpu://127.0.0.1:0/0", "--native", "--native_echo")
+    headline = None
+    try:
+        host_port = srv.endpoint.split("//", 1)[1].rsplit("/", 1)[0]
+        host, port = host_port.rsplit(":", 1)
+        port = int(port)
+        dur = 400 if QUICK else 2000
+        print("# native tpu:// tunnel sweep (shm block pools, C++ both "
+              "ends):", file=sys.stderr)
+        for size, conns, depth in [(4096, 8, 4), (65536, 8, 4),
+                                   (1 << 20, 2, 4), (16 << 20, 2, 4)]:
+            r = bench_echo_native(host, port, conns=conns, depth=depth,
+                                  payload=size, duration_ms=dur, tpu=True)
+            print(f"#   {size:>9}B x{conns}conns x{depth}deep: "
+                  f"{r['gbps']:7.3f} GB/s  qps={r['qps']:9,.0f}  "
+                  f"p50={r['p50_us']/1e3:8.2f}ms "
+                  f"p99={r['p99_us']/1e3:8.2f}ms", file=sys.stderr)
+            if size == HEADLINE_SIZE:
+                headline = r["gbps"]
+        return headline
+    finally:
+        srv.close()
+
+
 def bench_hybrid_native():
     """Python client/service code over the native engine (the hybrid lane
     most users run): QPS + 1MB attachment echo."""
@@ -291,6 +324,9 @@ def bench_device_probe():
 def main() -> None:
     bench_multi_threaded_echo()
     native_1mb = bench_native_lane()
+    tpu_1mb = bench_native_tpu_lane()
+    if native_1mb is not None and tpu_1mb is not None:
+        native_1mb = max(native_1mb, tpu_1mb)
     bench_hybrid_native()
     py_1mb = bench_tpu_sweep()
     if os.environ.get("BENCH_SKIP_DEVICE") != "1" and not QUICK:

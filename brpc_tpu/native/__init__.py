@@ -140,6 +140,14 @@ def load_dataplane() -> Optional[ctypes.CDLL]:
         lib.dp_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                    ctypes.c_int, ctypes.c_int,
                                    ctypes.POINTER(ctypes.c_int)]
+        lib.dp_connect_tpu.restype = ctypes.c_uint64
+        lib.dp_connect_tpu.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_int, ctypes.c_int,
+                                       ctypes.c_int,
+                                       ctypes.POINTER(ctypes.c_int)]
+        lib.dp_listener_set_tpu.restype = ctypes.c_int
+        lib.dp_listener_set_tpu.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                            ctypes.c_int]
         lib.dp_send.restype = ctypes.c_int
         lib.dp_send.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                                 ctypes.c_char_p, ctypes.c_uint64]
@@ -160,6 +168,12 @@ def load_dataplane() -> Optional[ctypes.CDLL]:
         lib.dp_bench_echo.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_uint64, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+        ] + [ctypes.POINTER(ctypes.c_double)] * 5
+        lib.dp_bench_echo2.restype = ctypes.c_int
+        lib.dp_bench_echo2.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_char_p,
         ] + [ctypes.POINTER(ctypes.c_double)] * 5
         if lib.dp_abi_version() != 1:
             _dp_build_error = "dataplane abi mismatch"

@@ -39,6 +39,8 @@
 #include <stdlib.h>
 #include <string.h>
 #include <sys/epoll.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
 #include <sys/eventfd.h>
 #include <sys/socket.h>
 #include <sys/types.h>
@@ -54,6 +56,7 @@
 #include <memory>
 #include <mutex>
 #include <string>
+#include <random>
 #include <thread>
 #include <unordered_map>
 #include <vector>
@@ -66,6 +69,18 @@ constexpr uint64_t kDefaultMaxBody = 512ull << 20;
 constexpr uint64_t kWriteQueueMax = 64ull << 20;   // EOVERCROWDED beyond
 constexpr uint64_t kEventQueueMaxBytes = 512ull << 20;
 constexpr size_t kReadChunk = 256 * 1024;
+
+// TPUC tunnel framing (brpc_tpu/tpu/transport.py wire format — the
+// RDMA-endpoint analog: shm block pools + credit window over a TCP
+// bootstrap; this engine speaks it natively for the zero-kernel-copy
+// payload path)
+constexpr uint32_t kTpuHdrSize = 9;  // "TPUC" + u8 type + u32 len (BE)
+enum { TFT_HELLO = 1, TFT_HELLO_ACK = 2, TFT_DATA = 3, TFT_ACK = 4,
+       TFT_BYE = 5 };
+constexpr uint32_t kTpuInlineMax = 16 << 10;
+constexpr uint32_t kTpuBlockSize = 256 << 10;
+constexpr uint32_t kTpuBlockCount = 64;   // 16 MB window per direction
+constexpr int kTpuMaxSegs = 32;
 
 // event kinds (Python mirror in rpc/native_transport.py)
 enum {
@@ -274,7 +289,59 @@ struct RBuf {
   }
 };
 
+// Tunnel state for a TPUC conn (reference RdmaEndpoint: registered block
+// pool, credit window, bootstrap handshake — rdma_endpoint.cpp:127-130,
+// block_pool.cpp, rdma_endpoint.h:256-261).
+struct TpuState {
+  // our receive pool: WE create it, the PEER writes into it
+  std::string pool_name;
+  uint8_t* pool = nullptr;
+  size_t pool_len = 0;
+  uint32_t bs = kTpuBlockSize, bc = kTpuBlockCount;
+  bool pool_owner = false;
+  // the peer's pool: we write request/response bytes into it
+  uint8_t* peer = nullptr;
+  size_t peer_len = 0;
+  uint32_t peer_bs = 0, peer_bc = 0;
+  bool inline_only = false;  // cross-host fallback (pool not attachable)
+  // sender-side credit window over the peer's blocks
+  std::mutex cmu;
+  std::condition_variable ccv;
+  std::deque<uint32_t> credits;
+  bool closed = false;
+  // tunnel senders serialize (frame order IS stream order)
+  std::mutex smu;
+  // handshake rendezvous (dp_connect_tpu blocks here)
+  std::mutex hmu;
+  std::condition_variable hcv;
+  bool ready = false;
+  std::string err;
+  int ordinal = 0;
+  // native-service responses NEVER send from the loop thread (it must stay
+  // free to process the credit ACKs); one per-conn sender worker drains
+  // this queue in order
+  struct Resp {
+    std::string head;
+    uint8_t* base = nullptr;     // free() after send (stolen stream buffer)
+    const uint8_t* body = nullptr;
+    uint64_t blen = 0;
+  };
+  std::mutex qmu;
+  std::condition_variable qcv;
+  std::deque<Resp> respq;
+  bool sender_running = false;
+
+  ~TpuState() {
+    if (pool) munmap(pool, pool_len);
+    if (peer) munmap(peer, peer_len);
+    if (pool_owner && !pool_name.empty()) {
+      shm_unlink(("/" + pool_name).c_str());
+    }
+  }
+};
+
 struct Conn {
+  int listener_id = -1;
   uint64_t id = 0;
   int fd = -1;
   int loop = 0;
@@ -282,9 +349,15 @@ struct Conn {
   std::atomic<bool> failed{false};
   bool detached = false;
 
+  // TPUC tunnel: 0 = plain TCP conn; 1 = negotiating; 2 = ready
+  int tpu_mode = 0;
+  std::unique_ptr<TpuState> tpu;
   // read side (loop thread only)
   RBuf rbuf;
   size_t rpos = 0;
+  // reassembled tunnel byte stream (TRPC frames are cut from here)
+  RBuf sbuf;
+  size_t spos = 0;
 
   // write side (any thread; wmu guards)
   std::mutex wmu;
@@ -300,6 +373,7 @@ struct Conn {
 struct Listener {
   int fd = -1;
   int port = 0;
+  int tpu_ordinal = -1;  // >=0: conns speak the TPUC tunnel natively
 };
 
 struct Loop {
@@ -380,6 +454,166 @@ void arm(Runtime* rt, Conn* c, bool out) {
   epoll_ctl(rt->loops[c->loop]->epfd, EPOLL_CTL_MOD, c->fd, &ev);
 }
 
+// ------------------------------------------------------------- tpu tunnel
+bool tpu_create_pool(TpuState* t) {
+  char name[64];
+  static std::atomic<uint32_t> seq{0};
+  uint32_t rnd = 0;
+  {
+    std::random_device rd;  // unseeded rand() repeats across processes
+    rnd = rd();
+  }
+  snprintf(name, sizeof(name), "brpctpu_%x_%08x%04x", getpid(), rnd,
+           seq.fetch_add(1) & 0xffff);
+  t->pool_name = name;
+  int fd = shm_open(("/" + t->pool_name).c_str(),
+                    O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return false;
+  t->pool_len = size_t(t->bs) * t->bc;
+  if (ftruncate(fd, off_t(t->pool_len)) != 0) {
+    close(fd);
+    shm_unlink(("/" + t->pool_name).c_str());
+    return false;
+  }
+  t->pool = static_cast<uint8_t*>(mmap(nullptr, t->pool_len,
+                                       PROT_READ | PROT_WRITE, MAP_SHARED,
+                                       fd, 0));
+  close(fd);
+  if (t->pool == MAP_FAILED) {
+    t->pool = nullptr;
+    shm_unlink(("/" + t->pool_name).c_str());
+    return false;
+  }
+  t->pool_owner = true;
+  return true;
+}
+
+bool tpu_attach_peer(TpuState* t, const std::string& name, uint32_t bs,
+                     uint32_t bc) {
+  if (bs == 0 || bc == 0 || uint64_t(bs) * bc > (1ull << 30)) return false;
+  if (name.find('/') != std::string::npos) return false;
+  int fd = shm_open(("/" + name).c_str(), O_RDWR, 0600);
+  if (fd < 0) return false;
+  size_t len = size_t(bs) * bc;
+  struct stat st {};
+  // the claimed geometry must fit the object's REAL size — mapping past
+  // EOF turns the first copy into a SIGBUS from a hostile HELLO
+  if (fstat(fd, &st) != 0 || uint64_t(st.st_size) < len) {
+    close(fd);
+    return false;
+  }
+  t->peer = static_cast<uint8_t*>(mmap(nullptr, len,
+                                       PROT_READ | PROT_WRITE, MAP_SHARED,
+                                       fd, 0));
+  close(fd);
+  if (t->peer == MAP_FAILED) {
+    t->peer = nullptr;
+    return false;
+  }
+  t->peer_len = len;
+  t->peer_bs = bs;
+  t->peer_bc = bc;
+  {
+    std::lock_guard<std::mutex> lk(t->cmu);
+    t->credits.clear();
+    for (uint32_t i = 0; i < bc; i++) t->credits.push_back(i);
+  }
+  return true;
+}
+
+// flat-JSON field scanners — the HELLO body is a fixed flat dict
+// (tpu/transport.py _hello_body); a full JSON parser is not needed
+size_t json_value_pos(const std::string& s, const char* key) {
+  // position after `"key"` + `:` + optional whitespace; npos if absent
+  std::string pat = std::string("\"") + key + "\"";
+  size_t p = s.find(pat);
+  if (p == std::string::npos) return std::string::npos;
+  p += pat.size();
+  while (p < s.size() && (s[p] == ' ' || s[p] == '\t')) p++;
+  if (p >= s.size() || s[p] != ':') return std::string::npos;
+  p++;
+  while (p < s.size() && (s[p] == ' ' || s[p] == '\t')) p++;
+  return p;
+}
+
+bool json_str(const std::string& s, const char* key, std::string* out) {
+  size_t p = json_value_pos(s, key);
+  if (p == std::string::npos || p >= s.size() || s[p] != '"') return false;
+  p++;
+  size_t e = s.find('"', p);
+  if (e == std::string::npos) return false;
+  *out = s.substr(p, e - p);
+  return true;
+}
+
+bool json_int(const std::string& s, const char* key, int64_t* out) {
+  size_t p = json_value_pos(s, key);
+  if (p == std::string::npos) return false;
+  char* end = nullptr;
+  long long v = strtoll(s.c_str() + p, &end, 10);
+  if (end == s.c_str() + p) return false;
+  *out = v;
+  return true;
+}
+
+std::string tpu_hello_json(TpuState* t, int ordinal) {
+  char buf[256];
+  snprintf(buf, sizeof(buf),
+           "{\"v\": 1, \"pool\": \"%s\", \"bs\": %u, \"bc\": %u, "
+           "\"ordinal\": %d, \"pid\": %d}",
+           t->pool_name.c_str(), t->bs, t->bc, ordinal, getpid());
+  return buf;
+}
+
+int conn_writev(Runtime* rt, const std::shared_ptr<Conn>& c,
+                const uint8_t* const* bufs, const uint64_t* lens, int nseg);
+int tpu_send_packet(Runtime* rt, const std::shared_ptr<Conn>& c,
+                    const uint8_t* const* bufs, const uint64_t* lens,
+                    int nseg);
+
+// send one TPUC ctrl frame: 9-byte header + body segments
+int tpu_ctrl_send(Runtime* rt, const std::shared_ptr<Conn>& c, uint8_t ftype,
+                  const uint8_t* const* body_bufs, const uint64_t* body_lens,
+                  int nbody) {
+  uint64_t body_len = 0;
+  for (int i = 0; i < nbody; i++) body_len += body_lens[i];
+  uint8_t hdr[kTpuHdrSize];
+  memcpy(hdr, "TPUC", 4);
+  hdr[4] = ftype;
+  uint32_t be = htonl(uint32_t(body_len));
+  memcpy(hdr + 5, &be, 4);
+  const uint8_t* bufs[34];
+  uint64_t lens[34];
+  bufs[0] = hdr;
+  lens[0] = kTpuHdrSize;
+  for (int i = 0; i < nbody && i < 33; i++) {
+    bufs[i + 1] = body_bufs[i];
+    lens[i + 1] = body_lens[i];
+  }
+  return conn_writev(rt, c, bufs, lens, nbody + 1);
+}
+
+void tpu_teardown(Conn* c) {
+  TpuState* t = c->tpu.get();
+  if (t == nullptr) return;
+  {
+    std::lock_guard<std::mutex> lk(t->cmu);
+    t->closed = true;
+  }
+  t->ccv.notify_all();
+  t->qcv.notify_all();
+  {
+    std::lock_guard<std::mutex> lk(t->qmu);
+    for (auto& r : t->respq) free(r.base);
+    t->respq.clear();
+  }
+  {
+    std::lock_guard<std::mutex> lk(t->hmu);
+    if (!t->ready && t->err.empty()) t->err = "connection failed";
+  }
+  t->hcv.notify_all();
+}
+
 // Fail a connection: unregister, close, emit event, drop from table.
 // Runs on the owning loop thread (writers route through loop_submit).
 void conn_fail(Runtime* rt, const std::shared_ptr<Conn>& c, int err_class,
@@ -394,6 +628,7 @@ void conn_fail(Runtime* rt, const std::shared_ptr<Conn>& c, int err_class,
     close(c->fd);
     c->fd = -1;
   }
+  tpu_teardown(c.get());
   emit_failed(rt, c.get(), err_class, reason);
   std::lock_guard<std::mutex> lk(rt->cmu);
   rt->conns.erase(c->id);
@@ -520,7 +755,7 @@ bool echo_match(Runtime* rt, const MetaLite& m) {
 // go to Python instead.
 bool try_native_echo(Runtime* rt, const std::shared_ptr<Conn>& c,
                      const MetaLite& m, const uint8_t* body,
-                     uint64_t body_len) {
+                     uint64_t body_len, RBuf* whole_buf) {
   if (!c->is_server || !m.has_request || m.has_response || m.compress_type ||
       m.checksum || m.has_stream_settings || m.has_auth) {
     return false;
@@ -541,10 +776,74 @@ bool try_native_echo(Runtime* rt, const std::shared_ptr<Conn>& c,
   // body still points into the conn's read buffer: conn_writev either puts
   // it on the wire or copies the remainder before returning, so the
   // zero-assembly reference is safe
-  const uint8_t* bufs[2] = {reinterpret_cast<const uint8_t*>(head.data()),
-                            body};
-  const uint64_t lens[2] = {head.size(), body_len};
-  int rc = conn_writev(rt, c, bufs, lens, 2);
+  if (c->tpu_mode != 0) {
+    // NEVER send from the loop thread: tpu_send_packet may wait for
+    // credit ACKs that only this thread can deliver. One per-conn sender
+    // worker drains responses in order; a send failure fails the conn
+    // (a consumed request must never be silently dropped).
+    TpuState* t = c->tpu.get();
+    if (t == nullptr) return false;
+    TpuState::Resp resp;
+    resp.head = std::move(head);
+    if (whole_buf != nullptr && body_len >= (64 << 10)) {
+      // the stream buffer holds exactly this one frame: donate it to the
+      // sender instead of copying the body (single-core: copies are
+      // serial wall-clock)
+      resp.base = whole_buf->data;
+      resp.body = body;
+      resp.blen = body_len;
+      whole_buf->data = nullptr;
+      whole_buf->cap = 0;
+      whole_buf->size = 0;
+    } else {
+      resp.base = static_cast<uint8_t*>(malloc(body_len ? body_len : 1));
+      memcpy(resp.base, body, body_len);
+      resp.body = resp.base;
+      resp.blen = body_len;
+    }
+    {
+      std::lock_guard<std::mutex> lk(t->qmu);
+      t->respq.push_back(std::move(resp));
+      if (!t->sender_running) {
+        t->sender_running = true;
+        std::thread([rt, c] {
+          TpuState* ts = c->tpu.get();
+          for (;;) {
+            TpuState::Resp item;
+            {
+              std::unique_lock<std::mutex> qlk(ts->qmu);
+              ts->qcv.wait(qlk, [ts, &c] {
+                return !ts->respq.empty() || ts->closed ||
+                       c->failed.load();
+              });
+              if (ts->respq.empty()) return;  // closed/failed: drain done
+              item = std::move(ts->respq.front());
+              ts->respq.pop_front();
+            }
+            const uint8_t* bb[2] = {
+                reinterpret_cast<const uint8_t*>(item.head.data()),
+                item.body};
+            const uint64_t ll[2] = {item.head.size(), item.blen};
+            int rc = tpu_send_packet(rt, c, bb, ll, 2);
+            free(item.base);
+            if (rc != DPE_OK) {
+              loop_submit(rt, c->loop, [rt, c] {
+                conn_fail(rt, c, DPE_IO,
+                          "native service response undeliverable");
+              });
+              return;
+            }
+          }
+        }).detach();
+      }
+    }
+    t->qcv.notify_one();
+    return true;
+  }
+  const uint8_t* bufs2[2] = {reinterpret_cast<const uint8_t*>(head.data()),
+                             body};
+  const uint64_t lens2[2] = {head.size(), body_len};
+  int rc = conn_writev(rt, c, bufs2, lens2, 2);
   if (rc != DPE_OK) {
     // a consumed request whose response can't be queued leaves the client
     // hanging — the stream contract is broken, tear the conn down
@@ -602,17 +901,22 @@ void conn_detach(Runtime* rt, const std::shared_ptr<Conn>& c) {
   rt->conns.erase(c->id);
 }
 
-// Cut complete frames out of c->rbuf (loop thread only).
-void conn_parse(Runtime* rt, const std::shared_ptr<Conn>& c) {
-  RBuf& buf = c->rbuf;
+// Cut complete TRPC/TSTR frames out of (buf, pos) — the wire buffer for
+// plain conns, the reassembled tunnel stream for TPUC conns.
+void cut_trpc(Runtime* rt, const std::shared_ptr<Conn>& c, RBuf& buf,
+              size_t& pos, bool allow_detach) {
   for (;;) {
-    size_t avail = buf.size - c->rpos;
+    size_t avail = buf.size - pos;
     if (avail < kHeaderSize) break;
-    const uint8_t* p = buf.data + c->rpos;
+    const uint8_t* p = buf.data + pos;
     bool is_trpc = memcmp(p, "TRPC", 4) == 0;
     bool is_tstr = !is_trpc && memcmp(p, "TSTR", 4) == 0;
     if (!is_trpc && !is_tstr) {
-      conn_detach(rt, c);
+      if (allow_detach) {
+        conn_detach(rt, c);
+      } else {
+        conn_fail(rt, c, DPE_PROTOCOL, "garbage in tunnel stream");
+      }
       return;
     }
     uint32_t meta_size = ntohl(*reinterpret_cast<const uint32_t*>(p + 4));
@@ -627,22 +931,170 @@ void conn_parse(Runtime* rt, const std::shared_ptr<Conn>& c) {
     const uint8_t* body = meta + meta_size;
     c->in_msgs.fetch_add(1, std::memory_order_relaxed);
     bool handled = false;
+    bool whole = (pos == 0 && kHeaderSize + total == buf.size);
     if (is_trpc) {
       MetaLite m;
       if (parse_meta_lite(meta, meta + meta_size, &m)) {
-        handled = try_native_echo(rt, c, m, body, body_size);
+        handled = try_native_echo(rt, c, m, body, body_size,
+                                  whole ? &buf : nullptr);
+        if (handled && buf.data == nullptr) {
+          pos = 0;  // the echo stole the buffer
+          return;
+        }
       } else {
         conn_fail(rt, c, DPE_PROTOCOL, "bad RpcMeta");
         return;
       }
     }
     if (!handled) {
+      if (pos == 0 && kHeaderSize + total == buf.size &&
+          total >= (64 << 10)) {
+        // the buffer holds exactly this one large frame: hand the WHOLE
+        // buffer to the consumer instead of memcpy'ing megabytes — the
+        // dominant copy on the delivery path (this machine is single-core;
+        // every copy is serial wall-clock)
+        DpEvent ev{};
+        ev.kind = EV_FRAME;
+        ev.tag = is_tstr ? 1 : 0;
+        ev.conn_id = c->id;
+        ev.base = buf.data;
+        ev.meta = buf.data + kHeaderSize;
+        ev.meta_len = meta_size;
+        ev.body = buf.data + kHeaderSize + meta_size;
+        ev.body_len = body_size;
+        buf.data = nullptr;
+        buf.cap = 0;
+        buf.size = 0;
+        pos = 0;
+        push_event(rt, ev);
+        return;
+      }
       deliver_frame(rt, c.get(), is_tstr ? 1 : 0, meta, meta_size, body,
                     body_size);
     }
-    c->rpos += kHeaderSize + total;
+    pos += kHeaderSize + total;
   }
   // compact
+  if (pos == buf.size) {
+    buf.size = 0;
+    pos = 0;
+  } else if (pos > (1 << 20)) {
+    memmove(buf.data, buf.data + pos, buf.size - pos);
+    buf.size -= pos;
+    pos = 0;
+  }
+}
+
+// ---- TPUC tunnel frame processing (reference RdmaEndpoint recv path:
+// blocks -> reassembled stream -> the SAME message cutter as TCP,
+// input_messenger.cpp:416)
+void tpu_handle_hello(Runtime* rt, const std::shared_ptr<Conn>& c,
+                      const std::string& body);
+void tpu_handle_hello_ack(Runtime* rt, const std::shared_ptr<Conn>& c,
+                          const std::string& body);
+
+void tpu_parse(Runtime* rt, const std::shared_ptr<Conn>& c) {
+  RBuf& buf = c->rbuf;
+  TpuState* t = c->tpu.get();
+  for (;;) {
+    size_t avail = buf.size - c->rpos;
+    if (avail < kTpuHdrSize) break;
+    const uint8_t* p = buf.data + c->rpos;
+    if (memcmp(p, "TPUC", 4) != 0) {
+      conn_fail(rt, c, DPE_PROTOCOL, "bad tunnel magic");
+      return;
+    }
+    uint8_t ftype = p[4];
+    uint32_t blen = ntohl(*reinterpret_cast<const uint32_t*>(p + 5));
+    if (ftype < TFT_HELLO || ftype > TFT_BYE || blen > (32u << 20)) {
+      conn_fail(rt, c, DPE_PROTOCOL, "bad tunnel frame");
+      return;
+    }
+    if (avail < kTpuHdrSize + blen) break;
+    const uint8_t* body = p + kTpuHdrSize;
+    switch (ftype) {
+      case TFT_HELLO:
+        tpu_handle_hello(rt, c, std::string(
+            reinterpret_cast<const char*>(body), blen));
+        break;
+      case TFT_HELLO_ACK:
+        tpu_handle_hello_ack(rt, c, std::string(
+            reinterpret_cast<const char*>(body), blen));
+        break;
+      case TFT_DATA: {
+        if (blen < 8) {
+          conn_fail(rt, c, DPE_PROTOCOL, "short DATA frame");
+          return;
+        }
+        uint32_t inline_len = ntohl(*reinterpret_cast<const uint32_t*>(body));
+        uint32_t nsegs = ntohl(*reinterpret_cast<const uint32_t*>(body + 4));
+        if (8 + uint64_t(inline_len) + uint64_t(nsegs) * 8 > blen ||
+            nsegs > 4096) {
+          conn_fail(rt, c, DPE_PROTOCOL, "bad DATA frame");
+          return;
+        }
+        if (inline_len) {
+          memcpy(c->sbuf.tail(inline_len), body + 8, inline_len);
+          c->sbuf.size += inline_len;
+        }
+        if (nsegs) {
+          // copy the peer-written registered blocks into the stream, then
+          // return the credits (reference explicit-ACK sliding window)
+          std::string ack;
+          ack.resize(4 + size_t(nsegs) * 4);
+          uint32_t n_be = htonl(nsegs);
+          memcpy(&ack[0], &n_be, 4);
+          const uint8_t* sp = body + 8 + inline_len;
+          for (uint32_t i = 0; i < nsegs; i++) {
+            uint32_t idx = ntohl(*reinterpret_cast<const uint32_t*>(
+                sp + i * 8));
+            uint32_t ln = ntohl(*reinterpret_cast<const uint32_t*>(
+                sp + i * 8 + 4));
+            if (t == nullptr || t->pool == nullptr || idx >= t->bc ||
+                ln > t->bs) {
+              conn_fail(rt, c, DPE_PROTOCOL, "bad block ref");
+              return;
+            }
+            memcpy(c->sbuf.tail(ln), t->pool + size_t(idx) * t->bs, ln);
+            c->sbuf.size += ln;
+            uint32_t idx_be = htonl(idx);
+            memcpy(&ack[4 + size_t(i) * 4], &idx_be, 4);
+          }
+          const uint8_t* ab[1] = {
+              reinterpret_cast<const uint8_t*>(ack.data())};
+          const uint64_t al[1] = {ack.size()};
+          if (tpu_ctrl_send(rt, c, TFT_ACK, ab, al, 1) != DPE_OK) {
+            conn_fail(rt, c, DPE_IO, "ACK send failed");
+            return;
+          }
+        }
+        break;
+      }
+      case TFT_ACK: {
+        if (blen < 4) break;
+        uint32_t n = ntohl(*reinterpret_cast<const uint32_t*>(body));
+        if (4 + uint64_t(n) * 4 > blen) break;
+        if (t != nullptr) {
+          {
+            std::lock_guard<std::mutex> lk(t->cmu);
+            for (uint32_t i = 0; i < n; i++) {
+              uint32_t idx = ntohl(*reinterpret_cast<const uint32_t*>(
+                  body + 4 + size_t(i) * 4));
+              if (idx < t->peer_bc) t->credits.push_back(idx);
+            }
+          }
+          t->ccv.notify_all();
+        }
+        break;
+      }
+      case TFT_BYE:
+        conn_fail(rt, c, DPE_EOF, "peer sent BYE");
+        return;
+    }
+    if (c->failed.load()) return;
+    c->rpos += kTpuHdrSize + blen;
+  }
+  // compact the wire buffer
   if (c->rpos == buf.size) {
     buf.size = 0;
     c->rpos = 0;
@@ -651,6 +1103,38 @@ void conn_parse(Runtime* rt, const std::shared_ptr<Conn>& c) {
     buf.size -= c->rpos;
     c->rpos = 0;
   }
+  // cut RPC messages from the reassembled stream — same cutter as TCP
+  cut_trpc(rt, c, c->sbuf, c->spos, /*allow_detach=*/false);
+}
+
+// Parse dispatcher (loop thread only).
+void conn_parse(Runtime* rt, const std::shared_ptr<Conn>& c) {
+  if (c->tpu_mode != 0) {
+    tpu_parse(rt, c);
+    return;
+  }
+  // a TPUC HELLO on a tpu-enabled native listener upgrades the conn to a
+  // native tunnel endpoint (reference AppConnect handshake-then-switch);
+  // on a plain listener it detaches to the Python transport
+  if (c->is_server && c->rbuf.size - c->rpos >= 4 &&
+      memcmp(c->rbuf.data + c->rpos, "TPUC", 4) == 0) {
+    int ordinal = -1;
+    {
+      std::lock_guard<std::mutex> lk(rt->cmu);
+      if (c->listener_id >= 0 &&
+          size_t(c->listener_id) < rt->listeners.size()) {
+        ordinal = rt->listeners[size_t(c->listener_id)].tpu_ordinal;
+      }
+    }
+    if (ordinal >= 0) {
+      c->tpu_mode = 1;
+      c->tpu.reset(new TpuState());
+      c->tpu->ordinal = ordinal;
+      tpu_parse(rt, c);
+      return;
+    }
+  }
+  cut_trpc(rt, c, c->rbuf, c->rpos, /*allow_detach=*/true);
 }
 
 void conn_readable(Runtime* rt, const std::shared_ptr<Conn>& c) {
@@ -691,6 +1175,216 @@ void conn_readable(Runtime* rt, const std::shared_ptr<Conn>& c) {
       return;
     }
   }
+}
+
+void tpu_handle_hello(Runtime* rt, const std::shared_ptr<Conn>& c,
+                      const std::string& body) {
+  TpuState* t = c->tpu.get();
+  if (t == nullptr || c->tpu_mode == 2) {
+    conn_fail(rt, c, DPE_PROTOCOL, "unexpected HELLO");
+    return;
+  }
+  std::string pool;
+  int64_t bs = 0, bc = 0, requested = 0;
+  json_str(body, "pool", &pool);
+  json_int(body, "bs", &bs);
+  json_int(body, "bc", &bc);
+  json_int(body, "ordinal", &requested);
+  if (t->ordinal >= 0 && requested != t->ordinal) {
+    // refuse a dial addressed to a device this server does not front
+    char err[160];
+    snprintf(err, sizeof(err),
+             "{\"v\": 1, \"pool\": \"\", \"bs\": 0, \"bc\": 0, "
+             "\"ordinal\": %d, \"err\": \"server fronts device %d, "
+             "dial requested %d\"}",
+             t->ordinal, t->ordinal, int(requested));
+    const uint8_t* b[1] = {reinterpret_cast<const uint8_t*>(err)};
+    const uint64_t l[1] = {strlen(err)};
+    tpu_ctrl_send(rt, c, TFT_HELLO_ACK, b, l, 1);
+    conn_fail(rt, c, DPE_PROTOCOL, "device ordinal mismatch");
+    return;
+  }
+  if (!tpu_create_pool(t)) {
+    conn_fail(rt, c, DPE_IO, "cannot create shm pool");
+    return;
+  }
+  if (pool.empty() ||
+      !tpu_attach_peer(t, pool, uint32_t(bs), uint32_t(bc))) {
+    t->inline_only = true;  // cross-host fallback: inline DATA frames only
+  }
+  std::string ack = tpu_hello_json(t, int(t->ordinal >= 0 ? t->ordinal
+                                                          : requested));
+  const uint8_t* b[1] = {reinterpret_cast<const uint8_t*>(ack.data())};
+  const uint64_t l[1] = {ack.size()};
+  if (tpu_ctrl_send(rt, c, TFT_HELLO_ACK, b, l, 1) != DPE_OK) {
+    conn_fail(rt, c, DPE_IO, "HELLO_ACK send failed");
+    return;
+  }
+  c->tpu_mode = 2;
+}
+
+void tpu_handle_hello_ack(Runtime* rt, const std::shared_ptr<Conn>& c,
+                          const std::string& body) {
+  TpuState* t = c->tpu.get();
+  if (t == nullptr) {
+    conn_fail(rt, c, DPE_PROTOCOL, "unexpected HELLO_ACK");
+    return;
+  }
+  std::string err;
+  if (json_str(body, "err", &err) && !err.empty()) {
+    {
+      std::lock_guard<std::mutex> lk(t->hmu);
+      t->err = err;
+    }
+    t->hcv.notify_all();
+    conn_fail(rt, c, DPE_PROTOCOL, "handshake refused");
+    return;
+  }
+  std::string pool;
+  int64_t bs = 0, bc = 0;
+  json_str(body, "pool", &pool);
+  json_int(body, "bs", &bs);
+  json_int(body, "bc", &bc);
+  if (pool.empty() ||
+      !tpu_attach_peer(t, pool, uint32_t(bs), uint32_t(bc))) {
+    t->inline_only = true;
+  }
+  c->tpu_mode = 2;
+  {
+    std::lock_guard<std::mutex> lk(t->hmu);
+    t->ready = true;
+  }
+  t->hcv.notify_all();
+}
+
+// Ship one RPC packet through the tunnel (reference CutFromIOBufList,
+// rdma_endpoint.h:89: post blocks, window--, stream through on exhaustion).
+int tpu_send_packet(Runtime* rt, const std::shared_ptr<Conn>& c,
+                    const uint8_t* const* bufs, const uint64_t* lens,
+                    int nseg) {
+  TpuState* t = c->tpu.get();
+  if (t == nullptr || c->tpu_mode != 2) return DPE_IO;
+  uint64_t total = 0;
+  for (int i = 0; i < nseg; i++) total += lens[i];
+  std::lock_guard<std::mutex> slk(t->smu);  // frame order IS stream order
+  if (c->failed.load()) return DPE_IO;
+  int vi = 0;
+  uint64_t voff = 0;
+  auto copy_out = [&](uint8_t* dst, uint64_t want) -> uint64_t {
+    uint64_t done = 0;
+    while (done < want && vi < nseg) {
+      uint64_t take = lens[vi] - voff;
+      if (take > want - done) take = want - done;
+      memcpy(dst + done, bufs[vi] + voff, take);
+      voff += take;
+      done += take;
+      if (voff == lens[vi]) {
+        vi++;
+        voff = 0;
+      }
+    }
+    return done;
+  };
+  if (t->inline_only || total <= kTpuInlineMax) {
+    uint64_t left = total;
+    while (left > 0 || total == 0) {
+      uint64_t part = left < kTpuBlockSize ? left : kTpuBlockSize;
+      std::string body;
+      body.resize(8 + part);
+      uint32_t il_be = htonl(uint32_t(part));
+      uint32_t z = 0;
+      memcpy(&body[0], &il_be, 4);
+      memcpy(&body[4], &z, 4);
+      copy_out(reinterpret_cast<uint8_t*>(&body[8]), part);
+      const uint8_t* b[1] = {reinterpret_cast<const uint8_t*>(body.data())};
+      const uint64_t l[1] = {body.size()};
+      int rc = tpu_ctrl_send(rt, c, TFT_DATA, b, l, 1);
+      if (rc != DPE_OK) {
+        if (left != total) {
+          // mid-packet failure desyncs the stream for good
+          loop_submit(rt, c->loop, [rt, c] {
+            conn_fail(rt, c, DPE_IO, "mid-packet tunnel send failure");
+          });
+        }
+        return rc;
+      }
+      left -= part;
+      if (total == 0) break;
+    }
+    return DPE_OK;
+  }
+  uint64_t sent = 0;
+  while (sent < total) {
+    uint32_t want_blocks =
+        uint32_t((total - sent + t->peer_bs - 1) / t->peer_bs);
+    if (want_blocks > uint32_t(kTpuMaxSegs)) want_blocks = kTpuMaxSegs;
+    std::vector<uint32_t> got;
+    {
+      std::unique_lock<std::mutex> lk(t->cmu);
+      if (!t->ccv.wait_for(lk, std::chrono::seconds(30), [t] {
+            return !t->credits.empty() || t->closed;
+          })) {
+        lk.unlock();
+        if (sent > 0) {
+          // frames of this packet already reached the peer's stream: it is
+          // desynced for good (Python send_packet fails the tunnel the
+          // same way)
+          loop_submit(rt, c->loop, [rt, c] {
+            conn_fail(rt, c, DPE_OVERCROWDED, "tunnel window wedged");
+          });
+        }
+        return DPE_OVERCROWDED;
+      }
+      if (t->closed) return DPE_IO;
+      while (!t->credits.empty() && got.size() < want_blocks) {
+        got.push_back(t->credits.front());
+        t->credits.pop_front();
+      }
+    }
+    std::vector<std::pair<uint32_t, uint32_t>> segs;
+    for (uint32_t idx : got) {
+      uint64_t want = total - sent;
+      if (want > t->peer_bs) want = t->peer_bs;
+      if (want == 0) break;
+      uint64_t wrote = copy_out(t->peer + size_t(idx) * t->peer_bs, want);
+      segs.emplace_back(idx, uint32_t(wrote));
+      sent += wrote;
+    }
+    if (segs.size() < got.size()) {
+      // grabbed more credits than needed — return the extras
+      std::lock_guard<std::mutex> lk(t->cmu);
+      for (size_t i = segs.size(); i < got.size(); i++) {
+        t->credits.push_back(got[i]);
+      }
+    }
+    std::string body;
+    body.resize(8 + segs.size() * 8);
+    uint32_t z = 0, ns_be = htonl(uint32_t(segs.size()));
+    memcpy(&body[0], &z, 4);
+    memcpy(&body[4], &ns_be, 4);
+    for (size_t i = 0; i < segs.size(); i++) {
+      uint32_t idx_be = htonl(segs[i].first);
+      uint32_t ln_be = htonl(segs[i].second);
+      memcpy(&body[8 + i * 8], &idx_be, 4);
+      memcpy(&body[8 + i * 8 + 4], &ln_be, 4);
+    }
+    const uint8_t* b[1] = {reinterpret_cast<const uint8_t*>(body.data())};
+    const uint64_t l[1] = {body.size()};
+    int rc = tpu_ctrl_send(rt, c, TFT_DATA, b, l, 1);
+    if (rc != DPE_OK) {
+      // the peer never saw these blocks: reclaim the credits, then kill
+      // the desynced stream if part of the packet already went out
+      {
+        std::lock_guard<std::mutex> lk(t->cmu);
+        for (auto& s : segs) t->credits.push_back(s.first);
+      }
+      loop_submit(rt, c->loop, [rt, c] {
+        conn_fail(rt, c, DPE_IO, "mid-packet tunnel send failure");
+      });
+      return rc;
+    }
+  }
+  return DPE_OK;
 }
 
 // ------------------------------------------------------------ registration
@@ -743,6 +1437,7 @@ void accept_ready(Runtime* rt, int lid) {
     setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bufsz, sizeof(bufsz));
     setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
     auto c = create_conn(rt, fd, /*is_server=*/true);
+    c->listener_id = lid;
     char host[NI_MAXHOST] = "?", serv[NI_MAXSERV] = "0";
     getnameinfo(reinterpret_cast<sockaddr*>(&ss), slen, host, sizeof(host),
                 serv, sizeof(serv), NI_NUMERICHOST | NI_NUMERICSERV);
@@ -930,6 +1625,14 @@ int dp_listener_close(void* h, int lid) {
   return 0;
 }
 
+int dp_listener_set_tpu(void* h, int lid, int ordinal) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::lock_guard<std::mutex> lk(rt->cmu);
+  if (lid < 0 || size_t(lid) >= rt->listeners.size()) return -1;
+  rt->listeners[size_t(lid)].tpu_ordinal = ordinal;
+  return 0;
+}
+
 int dp_listen_port(void* h, int lid) {
   auto* rt = static_cast<Runtime*>(h);
   std::lock_guard<std::mutex> lk(rt->cmu);
@@ -1002,6 +1705,63 @@ uint64_t dp_connect(void* h, const char* host, int port, int timeout_ms,
   return c->id;
 }
 
+void dp_conn_close(void* h, uint64_t conn_id);
+
+// Dial a tpu:// endpoint natively: TCP bootstrap + TPUC handshake + shm
+// pools, entirely in the engine (reference RdmaEndpoint AppConnect).
+uint64_t dp_connect_tpu(void* h, const char* host, int port, int ordinal,
+                        int timeout_ms, int* err_out) {
+  auto* rt = static_cast<Runtime*>(h);
+  uint64_t cid = dp_connect(h, host, port, timeout_ms, err_out);
+  if (!cid) return 0;
+  std::shared_ptr<Conn> c;
+  {
+    std::lock_guard<std::mutex> lk(rt->cmu);
+    auto it = rt->conns.find(cid);
+    if (it != rt->conns.end()) c = it->second;
+  }
+  if (!c) {
+    *err_out = ECONNRESET;
+    return 0;
+  }
+  auto* t = new TpuState();
+  t->ordinal = ordinal;
+  c->tpu.reset(t);
+  c->tpu_mode = 1;  // published before any byte can arrive: the peer only
+                    // speaks after our HELLO below
+  if (!tpu_create_pool(t)) {
+    dp_conn_close(h, cid);
+    *err_out = ENOMEM;
+    return 0;
+  }
+  std::string hello = tpu_hello_json(t, ordinal);
+  const uint8_t* b[1] = {reinterpret_cast<const uint8_t*>(hello.data())};
+  const uint64_t l[1] = {hello.size()};
+  if (tpu_ctrl_send(rt, c, TFT_HELLO, b, l, 1) != DPE_OK) {
+    dp_conn_close(h, cid);
+    *err_out = EPIPE;
+    return 0;
+  }
+  {
+    std::unique_lock<std::mutex> lk(t->hmu);
+    if (!t->hcv.wait_for(lk, std::chrono::milliseconds(
+            timeout_ms > 0 ? timeout_ms : 3000),
+            [t] { return t->ready || !t->err.empty(); })) {
+      lk.unlock();
+      dp_conn_close(h, cid);
+      *err_out = ETIMEDOUT;
+      return 0;
+    }
+    if (!t->ready) {
+      lk.unlock();
+      dp_conn_close(h, cid);
+      *err_out = ECONNREFUSED;
+      return 0;
+    }
+  }
+  return cid;
+}
+
 int dp_send(void* h, uint64_t conn_id, const uint8_t* data, uint64_t len) {
   auto* rt = static_cast<Runtime*>(h);
   std::shared_ptr<Conn> c;
@@ -1011,6 +1771,11 @@ int dp_send(void* h, uint64_t conn_id, const uint8_t* data, uint64_t len) {
     if (it != rt->conns.end()) c = it->second;
   }
   if (!c) return DPE_NOTFOUND;
+  if (c->tpu_mode != 0) {
+    const uint8_t* bufs[1] = {data};
+    const uint64_t lens[1] = {len};
+    return tpu_send_packet(rt, c, bufs, lens, 1);
+  }
   return conn_write(rt, c, data, len);
 }
 
@@ -1027,6 +1792,7 @@ int dp_sendv(void* h, uint64_t conn_id, const uint8_t* const* bufs,
     if (it != rt->conns.end()) c = it->second;
   }
   if (!c) return DPE_NOTFOUND;
+  if (c->tpu_mode != 0) return tpu_send_packet(rt, c, bufs, lens, nseg);
   return conn_writev(rt, c, bufs, lens, nseg);
 }
 
@@ -1085,11 +1851,11 @@ int dp_conn_stats(void* h, uint64_t conn_id, uint64_t* in_bytes,
 // This is ours: a pipelined echo client that drives the SAME engine lane
 // (dp_connect / conn_writev / the frame cutter) against a server, entirely
 // in C++, and reports QPS + latency percentiles + bandwidth.
-int dp_bench_echo(const char* host, int port, int nconns, int depth,
-                  uint64_t payload_len, int duration_ms,
-                  const char* service, const char* method,
-                  double* out_qps, double* out_gbps, double* out_p50_us,
-                  double* out_p99_us, double* out_p999_us) {
+int dp_bench_echo2(const char* host, int port, int use_tpu, int nconns,
+                   int depth, uint64_t payload_len, int duration_ms,
+                   const char* service, const char* method,
+                   double* out_qps, double* out_gbps, double* out_p50_us,
+                   double* out_p99_us, double* out_p999_us) {
   void* h = dp_rt_create(2, 0);
   // request packet: header + meta(RequestMeta{service,method}, cid) + body
   std::string reqmeta_tail;  // everything except the cid varint
@@ -1109,7 +1875,9 @@ int dp_bench_echo(const char* host, int port, int nconns, int depth,
   std::vector<uint64_t> conns;
   for (int i = 0; i < nconns; i++) {
     int err = 0;
-    uint64_t cid = dp_connect(h, host, port, 3000, &err);
+    uint64_t cid = use_tpu
+        ? dp_connect_tpu(h, host, port, 0, 5000, &err)
+        : dp_connect(h, host, port, 3000, &err);
     if (!cid) {
       dp_rt_shutdown(h);
       return -1;
@@ -1215,6 +1983,16 @@ int dp_bench_echo(const char* host, int port, int nconns, int depth,
   *out_p999_us = pct(0.999);
   dp_rt_shutdown(h);
   return 0;
+}
+
+int dp_bench_echo(const char* host, int port, int nconns, int depth,
+                  uint64_t payload_len, int duration_ms,
+                  const char* service, const char* method,
+                  double* out_qps, double* out_gbps, double* out_p50_us,
+                  double* out_p99_us, double* out_p999_us) {
+  return dp_bench_echo2(host, port, 0, nconns, depth, payload_len,
+                        duration_ms, service, method, out_qps, out_gbps,
+                        out_p50_us, out_p99_us, out_p999_us);
 }
 
 }  // extern "C"

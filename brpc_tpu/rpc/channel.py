@@ -165,6 +165,14 @@ class Channel:
         else:
             ep = self._remote
         if ep.is_tpu():
+            if (self.options.native_transport and ep.port
+                    and getattr(self._protocol, "magic", None) == b"TRPC"):
+                from brpc_tpu.rpc.native_transport import get_dataplane
+
+                dp = get_dataplane()
+                if dp is not None:  # native tunnel; Python fallback below
+                    return dp.get_or_connect(
+                        ep, int(self.options.connect_timeout_ms))
             from brpc_tpu.tpu.tpusocket import get_tpu_socket
 
             return get_tpu_socket(ep)

@@ -243,11 +243,15 @@ class NativeDataplane:
     def close_conn(self, conn_id: int) -> None:
         self._lib.dp_conn_close(self._rt, conn_id)
 
-    def listen(self, server, host: str, port: int) -> Tuple[int, int]:
-        """Returns (listener_id, bound_port); raises OSError on failure."""
+    def listen(self, server, host: str, port: int,
+               tpu_ordinal: int = -1) -> Tuple[int, int]:
+        """Returns (listener_id, bound_port); raises OSError on failure.
+        tpu_ordinal >= 0 makes accepted TPUC handshakes native tunnels."""
         lid = self._lib.dp_listen(self._rt, host.encode(), port)
         if lid < 0:
             raise OSError(-lid, f"dp_listen({host}:{port})")
+        if tpu_ordinal >= 0:
+            self._lib.dp_listener_set_tpu(self._rt, lid, tpu_ordinal)
         bound = self._lib.dp_listen_port(self._rt, lid)
         with self._lock:
             self._servers[lid] = server
@@ -291,15 +295,34 @@ class NativeDataplane:
         self.register_socket(conn, sock)
         return sock
 
+    def connect_tpu(self, ep: EndPoint,
+                    timeout_ms: int = 3000) -> NativeSocket:
+        """Dial a tpu:// endpoint through the engine: TCP bootstrap + TPUC
+        handshake + shm block pools, all native (the RDMA-analog lane of
+        tpu/transport.py with the data path in C++)."""
+        err = ctypes.c_int(0)
+        conn = self._lib.dp_connect_tpu(
+            self._rt, (ep.host or "127.0.0.1").encode(), ep.port,
+            max(ep.device_ordinal, 0), timeout_ms, ctypes.byref(err))
+        if not conn:
+            raise ConnectionError(
+                f"native tpu connect to {ep} failed: errno={err.value}")
+        sock = NativeSocket(self, conn, ep, is_server=False)
+        self.register_socket(conn, sock)
+        return sock
+
     def get_or_connect(self, ep: EndPoint,
                        timeout_ms: int = 3000) -> NativeSocket:
         """Shared client connection per endpoint (SocketMap analog)."""
-        key = (ep.host or "127.0.0.1", ep.port)
+        is_tpu = ep.is_tpu()
+        key = (ep.host or "127.0.0.1", ep.port,
+               ep.device_ordinal if is_tpu else -1)
         with self._conn_map_lock:
             sock = self._conn_map.get(key)
             if sock is not None and not sock.failed:
                 return sock
-        sock = self.connect(ep, timeout_ms)
+        sock = self.connect_tpu(ep, timeout_ms) if is_tpu \
+            else self.connect(ep, timeout_ms)
         with self._conn_map_lock:
             cur = self._conn_map.get(key)
             if cur is not None and not cur.failed:
@@ -540,10 +563,12 @@ def dataplane_available() -> bool:
 
 def bench_echo_native(host: str, port: int, *, conns: int = 8, depth: int = 4,
                       payload: int = 16, duration_ms: int = 2000,
-                      service: str = "EchoService", method: str = "Echo"):
+                      service: str = "EchoService", method: str = "Echo",
+                      tpu: bool = False):
     """Run the C++ pipelined echo bench client (the framework's native lane
     end to end — the analog of the reference's C++ bench binaries,
-    example/multi_threaded_echo_c++/client.cpp). Returns a dict of
+    example/multi_threaded_echo_c++/client.cpp). ``tpu=True`` dials the
+    TPUC shm tunnel (the rdma_performance analog). Returns a dict of
     qps/gbps/p50_us/p99_us/p999_us, or None when the engine is missing."""
     from brpc_tpu import native
 
@@ -551,9 +576,10 @@ def bench_echo_native(host: str, port: int, *, conns: int = 8, depth: int = 4,
     if lib is None:
         return None
     outs = [ctypes.c_double() for _ in range(5)]
-    rc = lib.dp_bench_echo(host.encode(), port, conns, depth, payload,
-                           duration_ms, service.encode(), method.encode(),
-                           *[ctypes.byref(o) for o in outs])
+    rc = lib.dp_bench_echo2(host.encode(), port, 1 if tpu else 0, conns,
+                            depth, payload, duration_ms, service.encode(),
+                            method.encode(),
+                            *[ctypes.byref(o) for o in outs])
     if rc != 0:
         raise RuntimeError(f"dp_bench_echo failed: rc={rc}")
     keys = ("qps", "gbps", "p50_us", "p99_us", "p999_us")

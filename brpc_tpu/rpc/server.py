@@ -231,9 +231,8 @@ class Server:
 
             self._ssl_ctx = build_server_context(self.options.ssl)
         ep = EndPoint.parse(address)
-        if (self.options.native_dataplane and not ep.is_tpu()
-                and not ep.is_unix() and self.options.ssl is None
-                and self._start_native(ep)):
+        if (self.options.native_dataplane and not ep.is_unix()
+                and self.options.ssl is None and self._start_native(ep)):
             return self
         if ep.is_tpu():
             # tpu://host:port/ordinal — the TCP port is the tunnel bootstrap
@@ -277,9 +276,17 @@ class Server:
         if dp is None:
             return False
         host = ep.host or "0.0.0.0"
-        self._native_lid, port = dp.listen(self, host, ep.port)
+        tpu_ordinal = ep.device_ordinal if ep.is_tpu() else -1
+        if ep.is_tpu():
+            # tpu://host:port/ordinal — TPUC handshakes become native shm
+            # tunnels; plain TRPC/HTTP on the same port still works
+            self._tpu_ordinal = ep.device_ordinal
+        self._native_lid, port = dp.listen(self, host, ep.port,
+                                           tpu_ordinal=tpu_ordinal)
         self._native_dp = dp
-        self._listen_ep = EndPoint.from_ip_port(host, port)
+        self._listen_ep = EndPoint.from_tpu(host, ep.device_ordinal,
+                                            port=port) if ep.is_tpu() \
+            else EndPoint.from_ip_port(host, port)
         self._running = True
         self._logoff = False
         for svc, method in self._native_echoes:

@@ -209,3 +209,71 @@ class TestEngineBasics:
             stub.Echo(echo_pb2.EchoRequest(message="x"))
         # failed fast via socket error, not the 3s timeout
         assert time.monotonic() - t0 < 2.5
+
+
+class TestNativeTpuTunnel:
+    """The graft's native lane: TPUC shm tunnel in the C++ engine
+    (reference RdmaEndpoint blueprint) + interop with the Python
+    transport implementation of the same wire format."""
+
+    @pytest.fixture()
+    def tpu_native_server(self):
+        server = Server(ServerOptions(native_dataplane=True))
+        server.add_service(EchoImpl())
+        server.start("tpu://127.0.0.1:0/0")
+        yield server
+        server.stop()
+        server.join()
+
+    def test_native_client_native_server(self, tpu_native_server):
+        stub = _stub(tpu_native_server, native=True, timeout_ms=15000)
+        r = stub.Echo(echo_pb2.EchoRequest(message="nn",
+                                           payload=b"t" * 500000))
+        assert r.message == "nn" and len(r.payload) == 500000
+
+    def test_python_client_native_server(self, tpu_native_server):
+        stub = _stub(tpu_native_server, native=False, timeout_ms=15000)
+        r = stub.Echo(echo_pb2.EchoRequest(message="pn",
+                                           payload=b"p" * 300000))
+        assert r.message == "pn" and len(r.payload) == 300000
+
+    def test_native_client_python_server(self):
+        server = Server(ServerOptions())  # Python tpu transport end
+        server.add_service(EchoImpl())
+        server.start("tpu://127.0.0.1:0/0")
+        try:
+            stub = _stub(server, native=True, timeout_ms=15000)
+            r = stub.Echo(echo_pb2.EchoRequest(message="np",
+                                               payload=b"q" * 300000))
+            assert r.message == "np" and len(r.payload) == 300000
+        finally:
+            server.stop()
+            server.join()
+
+    def test_attachment_and_fastpath(self, tpu_native_server):
+        tpu_native_server.register_native_echo("EchoService", "Echo")
+        stub = _stub(tpu_native_server, native=True, timeout_ms=15000)
+        att = bytes(range(256)) * 2048  # 512KB through the block path
+        cntl = Controller()
+        cntl.request_attachment = att
+        r = stub.Echo(echo_pb2.EchoRequest(message="fast"), controller=cntl)
+        assert r.message == "fast" and cntl.response_attachment == att
+
+    def test_ordinal_mismatch_refused(self, tpu_native_server):
+        ep = tpu_native_server.listen_endpoint()
+        from brpc_tpu.butil.endpoint import EndPoint
+        from brpc_tpu.rpc.native_transport import get_dataplane
+
+        wrong = EndPoint.from_tpu(ep.host, 7, port=ep.port)
+        with pytest.raises(ConnectionError):
+            get_dataplane().connect_tpu(wrong, timeout_ms=3000)
+
+    def test_server_stop_fails_tunnel_clients(self, tpu_native_server):
+        stub = _stub(tpu_native_server, native=True, timeout_ms=3000)
+        stub.Echo(echo_pb2.EchoRequest(message="ok"))
+        tpu_native_server.stop()
+        tpu_native_server.join()
+        with pytest.raises(RpcError):
+            for _ in range(5):
+                stub.Echo(echo_pb2.EchoRequest(message="down"))
+                time.sleep(0.1)
