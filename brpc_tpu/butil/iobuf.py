@@ -80,6 +80,8 @@ class IOBuf:
     # ------------------------------------------------------------------- cut
     def cutn(self, n: int) -> "IOBuf":
         """Cut the first n bytes into a new IOBuf (zero-copy)."""
+        if n < 0:  # a negative n would silently corrupt the size invariant
+            raise ValueError(f"cutn({n})")
         out = IOBuf()
         self.cutn_into(n, out)
         return out
@@ -105,6 +107,8 @@ class IOBuf:
 
     def pop_front(self, n: int) -> int:
         """Drop the first n bytes."""
+        if n < 0:
+            raise ValueError(f"pop_front({n})")
         n = min(n, self._size)
         remain = n
         refs = self._refs

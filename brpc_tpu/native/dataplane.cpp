@@ -304,6 +304,7 @@ struct TpuState {
   size_t peer_len = 0;
   uint32_t peer_bs = 0, peer_bc = 0;
   bool inline_only = false;  // cross-host fallback (pool not attachable)
+  std::vector<uint8_t> inflight;  // per-block: handed out, not yet ACKed
   // sender-side credit window over the peer's blocks
   std::mutex cmu;
   std::condition_variable ccv;
@@ -330,6 +331,7 @@ struct TpuState {
   std::condition_variable qcv;
   std::deque<Resp> respq;
   bool sender_running = false;
+  bool q_closed = false;  // closed-mirror guarded by qmu (wakeup safety)
 
   ~TpuState() {
     if (pool) munmap(pool, pool_len);
@@ -496,8 +498,11 @@ bool tpu_attach_peer(TpuState* t, const std::string& name, uint32_t bs,
   if (fd < 0) return false;
   size_t len = size_t(bs) * bc;
   struct stat st {};
-  // the claimed geometry must fit the object's REAL size — mapping past
-  // EOF turns the first copy into a SIGBUS from a hostile HELLO
+  // the claimed geometry must fit the object's REAL size at attach time —
+  // mapping past EOF turns the first copy into a SIGBUS. NOTE this cannot
+  // stop a peer that ftruncates its pool AFTER the handshake; tunnel
+  // peers are processes of the same deployment (the reference's RDMA
+  // peers hold registered memory under the same trust model).
   if (fstat(fd, &st) != 0 || uint64_t(st.st_size) < len) {
     close(fd);
     return false;
@@ -517,6 +522,7 @@ bool tpu_attach_peer(TpuState* t, const std::string& name, uint32_t bs,
     std::lock_guard<std::mutex> lk(t->cmu);
     t->credits.clear();
     for (uint32_t i = 0; i < bc; i++) t->credits.push_back(i);
+    t->inflight.assign(bc, 0);
   }
   return true;
 }
@@ -582,11 +588,12 @@ int tpu_ctrl_send(Runtime* rt, const std::shared_ptr<Conn>& c, uint8_t ftype,
   hdr[4] = ftype;
   uint32_t be = htonl(uint32_t(body_len));
   memcpy(hdr + 5, &be, 4);
+  if (nbody < 0 || nbody > 33) return DPE_PROTOCOL;
   const uint8_t* bufs[34];
   uint64_t lens[34];
   bufs[0] = hdr;
   lens[0] = kTpuHdrSize;
-  for (int i = 0; i < nbody && i < 33; i++) {
+  for (int i = 0; i < nbody; i++) {
     bufs[i + 1] = body_bufs[i];
     lens[i + 1] = body_lens[i];
   }
@@ -601,11 +608,15 @@ void tpu_teardown(Conn* c) {
     t->closed = true;
   }
   t->ccv.notify_all();
-  t->qcv.notify_all();
   {
+    // set the flag and notify UNDER qmu: a notify racing the sender's
+    // predicate evaluation would otherwise be lost forever, pinning the
+    // sender thread (and the conn + shm mappings it holds) for good
     std::lock_guard<std::mutex> lk(t->qmu);
+    t->q_closed = true;
     for (auto& r : t->respq) free(r.base);
     t->respq.clear();
+    t->qcv.notify_all();
   }
   {
     std::lock_guard<std::mutex> lk(t->hmu);
@@ -813,7 +824,7 @@ bool try_native_echo(Runtime* rt, const std::shared_ptr<Conn>& c,
             {
               std::unique_lock<std::mutex> qlk(ts->qmu);
               ts->qcv.wait(qlk, [ts, &c] {
-                return !ts->respq.empty() || ts->closed ||
+                return !ts->respq.empty() || ts->q_closed ||
                        c->failed.load();
               });
               if (ts->respq.empty()) return;  // closed/failed: drain done
@@ -1080,7 +1091,14 @@ void tpu_parse(Runtime* rt, const std::shared_ptr<Conn>& c) {
             for (uint32_t i = 0; i < n; i++) {
               uint32_t idx = ntohl(*reinterpret_cast<const uint32_t*>(
                   body + 4 + size_t(i) * 4));
-              if (idx < t->peer_bc) t->credits.push_back(idx);
+              // only blocks actually in flight earn a credit back:
+              // replayed/forged ACKs must not inflate the window or hand
+              // a block to two writers at once
+              if (idx < t->peer_bc && idx < t->inflight.size() &&
+                  t->inflight[idx]) {
+                t->inflight[idx] = 0;
+                t->credits.push_back(idx);
+              }
             }
           }
           t->ccv.notify_all();
@@ -1180,7 +1198,10 @@ void conn_readable(Runtime* rt, const std::shared_ptr<Conn>& c) {
 void tpu_handle_hello(Runtime* rt, const std::shared_ptr<Conn>& c,
                       const std::string& body) {
   TpuState* t = c->tpu.get();
-  if (t == nullptr || c->tpu_mode == 2) {
+  if (t == nullptr || c->tpu_mode == 2 || !c->is_server ||
+      t->pool != nullptr) {
+    // a client conn (or a conn that already created its pool) must never
+    // re-run pool creation — it would leak the prior shm mapping
     conn_fail(rt, c, DPE_PROTOCOL, "unexpected HELLO");
     return;
   }
@@ -1337,8 +1358,10 @@ int tpu_send_packet(Runtime* rt, const std::shared_ptr<Conn>& c,
       }
       if (t->closed) return DPE_IO;
       while (!t->credits.empty() && got.size() < want_blocks) {
-        got.push_back(t->credits.front());
+        uint32_t idx = t->credits.front();
         t->credits.pop_front();
+        if (idx < t->inflight.size()) t->inflight[idx] = 1;
+        got.push_back(idx);
       }
     }
     std::vector<std::pair<uint32_t, uint32_t>> segs;
@@ -1354,6 +1377,7 @@ int tpu_send_packet(Runtime* rt, const std::shared_ptr<Conn>& c,
       // grabbed more credits than needed — return the extras
       std::lock_guard<std::mutex> lk(t->cmu);
       for (size_t i = segs.size(); i < got.size(); i++) {
+        if (got[i] < t->inflight.size()) t->inflight[got[i]] = 0;
         t->credits.push_back(got[i]);
       }
     }
@@ -1376,7 +1400,10 @@ int tpu_send_packet(Runtime* rt, const std::shared_ptr<Conn>& c,
       // the desynced stream if part of the packet already went out
       {
         std::lock_guard<std::mutex> lk(t->cmu);
-        for (auto& s : segs) t->credits.push_back(s.first);
+        for (auto& s : segs) {
+          if (s.first < t->inflight.size()) t->inflight[s.first] = 0;
+          t->credits.push_back(s.first);
+        }
       }
       loop_submit(rt, c->loop, [rt, c] {
         conn_fail(rt, c, DPE_IO, "mid-packet tunnel send failure");

@@ -25,6 +25,7 @@ def ensure_registered() -> None:
         from brpc_tpu.policy.grpc_protocol import GrpcProtocol
 
         from brpc_tpu.policy.mongo_protocol import MongoProtocol
+        from brpc_tpu.policy.rtmp import RtmpProtocol
         from brpc_tpu.policy.redis_protocol import RedisProtocol
         from brpc_tpu.policy.thrift_protocol import ThriftProtocol
         from brpc_tpu.policy.memcache import MemcacheProtocol
@@ -42,6 +43,7 @@ def ensure_registered() -> None:
         register_protocol(GrpcProtocol())
         register_protocol(RedisProtocol())
         register_protocol(MongoProtocol())
+        register_protocol(RtmpProtocol())
         register_protocol(ThriftProtocol())
         register_protocol(MemcacheProtocol())
         register_protocol(NsheadProtocol())

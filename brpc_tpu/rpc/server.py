@@ -139,6 +139,7 @@ class ServerOptions:
     rpc_dump_dir: Optional[str] = None  # sample requests here (rpc_dump)
     redis_service: object = None      # policy/redis_protocol.RedisService
     mongo_service: object = None      # policy/mongo_protocol.MongoService
+    rtmp_service: object = None       # policy/rtmp.RtmpService
     thrift_service: object = None     # policy/thrift_protocol.ThriftService
     nshead_service: object = None     # policy/nshead.NsheadService
     # serve TRPC traffic through the C++ engine (epoll + frame cutting in

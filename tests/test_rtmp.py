@@ -1,0 +1,205 @@
+"""RTMP live-media stack tests (reference policy/rtmp_protocol.cpp,
+rtmp.cpp): handshake, chunk mux/demux, AMF0 command plane, and the
+publish -> relay -> play path with a real publisher + player pair over
+loopback (SURVEY §4: no mocks)."""
+
+import struct
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.policy import amf0
+from brpc_tpu.policy.rtmp import (
+    MSG_AUDIO,
+    MSG_DATA_AMF0,
+    MSG_VIDEO,
+    ChunkReader,
+    RtmpClient,
+    RtmpService,
+    pack_chunks,
+)
+from brpc_tpu.rpc import Server, ServerOptions
+
+
+class TestAmf0:
+    def test_roundtrip(self):
+        vals = ["connect", 1.0, {"app": "live", "ok": True, "n": None},
+                [1.0, "two", False]]
+        assert amf0.decode_all(amf0.encode(*vals)) == vals
+
+    def test_long_string(self):
+        s = "x" * 70000
+        assert amf0.decode_all(amf0.encode(s)) == [s]
+
+    def test_malformed(self):
+        with pytest.raises(amf0.Amf0Error):
+            amf0.decode_all(b"\x00\x01")   # truncated number
+        with pytest.raises(amf0.Amf0Error):
+            amf0.decode_all(b"\x7f")       # unknown marker
+
+
+class TestChunkLayer:
+    def test_single_and_multi_chunk(self):
+        r = ChunkReader()
+        r.chunk_size = 4096
+        small = pack_chunks(3, MSG_AUDIO, 1, b"a" * 100)
+        big = pack_chunks(4, MSG_VIDEO, 1, b"v" * 10000)
+        buf = IOBuf(small + big)
+        msgs = r.feed(buf)
+        assert [(m[1], len(m[3])) for m in msgs] == [(MSG_AUDIO, 100),
+                                                     (MSG_VIDEO, 10000)]
+
+    def test_partial_delivery(self):
+        r = ChunkReader()
+        r.chunk_size = 4096
+        wire = pack_chunks(3, MSG_AUDIO, 1, b"z" * 5000)
+        buf = IOBuf(wire[:2000])
+        assert r.feed(buf) == []
+        buf.append(wire[2000:])
+        msgs = r.feed(buf)
+        assert len(msgs) == 1 and msgs[0][3] == b"z" * 5000
+
+    def test_fmt3_before_fmt0_rejected(self):
+        r = ChunkReader()
+        with pytest.raises(ValueError):
+            r.feed(IOBuf(bytes([0xC3]) + b"xx"))
+
+
+@pytest.fixture()
+def rtmp_server():
+    service = RtmpService()
+    server = Server(ServerOptions(rtmp_service=service))
+    server.start("127.0.0.1:0")
+    yield server, service
+    server.stop()
+    server.join(timeout=2)
+
+
+class TestPublishPlay:
+    def test_live_relay(self, rtmp_server):
+        server, service = rtmp_server
+        ep = server.listen_endpoint()
+        pub = RtmpClient(ep.host, ep.port, app="live")
+        sub = RtmpClient(ep.host, ep.port, app="live")
+        try:
+            pub_sid = pub.create_stream()
+            pub.publish("cam0", pub_sid)
+            got = []
+            event = threading.Event()
+
+            def on_frame(mtype, sid, payload):
+                got.append((mtype, payload))
+                if len(got) >= 4:
+                    event.set()
+
+            sub.on_frame = on_frame
+            sub_sid = sub.create_stream()
+            sub.play("cam0", sub_sid)
+            pub.send_metadata(pub_sid, "@setDataFrame",
+                              {"width": 640.0, "height": 480.0})
+            pub.send_frame(MSG_VIDEO, pub_sid, b"\x17keyframe" + b"v" * 5000)
+            pub.send_frame(MSG_AUDIO, pub_sid, b"\xaf\x01" + b"a" * 100)
+            pub.send_frame(MSG_VIDEO, pub_sid, b"\x27delta" + b"d" * 2000)
+            assert event.wait(5), got
+            kinds = [k for k, _ in got]
+            assert MSG_DATA_AMF0 in kinds
+            assert kinds.count(MSG_VIDEO) == 2 and MSG_AUDIO in kinds
+            video = [p for k, p in got if k == MSG_VIDEO]
+            assert video[0].startswith(b"\x17keyframe")
+            assert "cam0" in service.stream_names()
+        finally:
+            pub.close()
+            sub.close()
+
+    def test_late_joiner_gets_metadata(self, rtmp_server):
+        server, _ = rtmp_server
+        ep = server.listen_endpoint()
+        pub = RtmpClient(ep.host, ep.port)
+        try:
+            sid = pub.create_stream()
+            pub.publish("meta-stream", sid)
+            pub.send_metadata(sid, "@setDataFrame", {"fps": 30.0})
+            time.sleep(0.1)
+            late = RtmpClient(ep.host, ep.port)
+            try:
+                got = []
+                ev = threading.Event()
+                late.on_frame = lambda t, s, p: (got.append((t, p)),
+                                                 ev.set())
+                lsid = late.create_stream()
+                late.play("meta-stream", lsid)
+                assert ev.wait(5)
+                assert got[0][0] == MSG_DATA_AMF0
+                vals = amf0.decode_all(got[0][1])
+                assert vals[1]["fps"] == 30.0
+            finally:
+                late.close()
+        finally:
+            pub.close()
+
+    def test_rpc_still_served_on_same_port(self, rtmp_server):
+        """RTMP coexists with every other protocol on one port."""
+        from brpc_tpu.proto import echo_pb2
+        from brpc_tpu.rpc import Channel, ChannelOptions, Service, Stub
+
+        server, _ = rtmp_server
+
+        class EchoImpl(Service):
+            DESCRIPTOR = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+            def Echo(self, cntl, request, done):
+                return echo_pb2.EchoResponse(message=request.message)
+
+        server.add_service(EchoImpl())
+        ch = Channel(ChannelOptions(timeout_ms=3000))
+        ch.init(str(server.listen_endpoint()))
+        stub = Stub(ch, echo_pb2.DESCRIPTOR.services_by_name["EchoService"])
+        assert stub.Echo(echo_pb2.EchoRequest(message="rpc")).message == "rpc"
+
+
+class TestExtendedTimestamp:
+    def test_ext_ts_multichunk_roundtrip(self):
+        from brpc_tpu.policy.rtmp import MSG_SET_CHUNK_SIZE
+        import struct as _s
+
+        r = ChunkReader()
+        wire = pack_chunks(2, MSG_SET_CHUNK_SIZE, 0, _s.pack(">I", 4096))
+        # 4.66h into a stream: timestamp needs the extended field, payload
+        # spans several chunks (each continuation repeats the ext field)
+        ts = 0x1000000 + 123
+        wire += pack_chunks(4, MSG_VIDEO, 1, b"v" * 10000, timestamp=ts)
+        msgs = ChunkReader().feed(IOBuf(wire)) if False else r.feed(
+            IOBuf(wire))
+        assert msgs[-1][1] == MSG_VIDEO and len(msgs[-1][3]) == 10000
+        assert msgs[-1][4] == ts
+
+    def test_timestamp_passthrough_relay(self, rtmp_server):
+        server, _ = rtmp_server
+        ep = server.listen_endpoint()
+        pub = RtmpClient(ep.host, ep.port)
+        sub = RtmpClient(ep.host, ep.port)
+        try:
+            got = []
+            ev = threading.Event()
+            sub.on_frame = lambda t, s, p: None
+            orig = sub._on_message
+
+            def spy(mtype, sid, payload, timestamp=0):
+                if mtype == MSG_VIDEO:
+                    got.append(timestamp)
+                    ev.set()
+                orig(mtype, sid, payload, timestamp)
+
+            sub._on_message = spy
+            psid = pub.create_stream()
+            pub.publish("ts-stream", psid)
+            ssid = sub.create_stream()
+            sub.play("ts-stream", ssid)
+            pub.send_frame(MSG_VIDEO, psid, b"\x17f", timestamp=40000)
+            assert ev.wait(5)
+            assert got[0] == 40000  # publisher timestamps survive the relay
+        finally:
+            pub.close()
+            sub.close()

@@ -331,6 +331,49 @@ def target_bson(data: bytes) -> None:
     bson.decode(data)
 
 
+def seeds_rtmp_chunks():
+    import struct
+
+    from brpc_tpu.policy import amf0
+    from brpc_tpu.policy.rtmp import (MSG_AUDIO, MSG_COMMAND_AMF0,
+                                      MSG_SET_CHUNK_SIZE, pack_chunks)
+
+    return [
+        pack_chunks(2, MSG_SET_CHUNK_SIZE, 0, struct.pack(">I", 4096)),
+        pack_chunks(3, MSG_COMMAND_AMF0, 0,
+                    amf0.encode("connect", 1.0, {"app": "live"})),
+        pack_chunks(4, MSG_AUDIO, 1, b"a" * 300),
+        pack_chunks(3, MSG_COMMAND_AMF0, 1,
+                    amf0.encode("publish", 2.0, None, "cam", "live")),
+    ]
+
+
+def seeds_amf0():
+    from brpc_tpu.policy import amf0
+
+    return [
+        amf0.encode("_result", 1.0, {"a": [1.0, "x", None], "b": True}),
+        amf0.encode("onStatus", 0.0, None, {"level": "status"}),
+        amf0.encode("long", "y" * 70000),
+    ]
+
+
+def target_rtmp_chunks(data: bytes) -> None:
+    from brpc_tpu.policy.rtmp import ChunkReader
+
+    r = ChunkReader()
+    try:
+        r.feed(IOBuf(data))
+    except ValueError:
+        pass  # declared error contract
+
+
+def target_amf0(data: bytes) -> None:
+    from brpc_tpu.policy import amf0
+
+    amf0.decode_all(data)
+
+
 def target_thrift(data: bytes) -> None:
     from brpc_tpu.policy.thrift_protocol import ThriftProtocol
 
@@ -345,6 +388,12 @@ def _bson_error():
     from brpc_tpu.policy.bson import BsonError
 
     return BsonError
+
+
+def _amf0_error():
+    from brpc_tpu.policy.amf0 import Amf0Error
+
+    return Amf0Error
 
 
 def _allowed():
@@ -363,6 +412,8 @@ def _allowed():
         "nshead": (target_nshead, seeds_nshead, ()),
         "thrift": (target_thrift, seeds_thrift, ()),
         "mongo": (target_mongo, seeds_mongo, ()),
+        "rtmp_chunks": (target_rtmp_chunks, seeds_rtmp_chunks, ()),
+        "amf0": (target_amf0, seeds_amf0, (_amf0_error(),)),
         "bson": (target_bson,
                  lambda: [s[21:] for s in seeds_mongo()],  # raw body docs
                  (_bson_error(),)),
