@@ -129,3 +129,11 @@ class TestSingleFileExamples:
             except subprocess.TimeoutExpired:
                 srv.kill()
                 srv.wait()
+
+    def test_rtmp_live(self):
+        out = run_single("examples/rtmp_live/client.py", ["-n", "6"])
+        assert "relayed" in out and "OK" in out
+
+    def test_mongo_kv(self):
+        out = run_single("examples/mongo_kv/client.py", ["-n", "3"])
+        assert "find key2" in out and "OK" in out

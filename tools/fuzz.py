@@ -17,10 +17,15 @@ is a crash; the harness prints the repro (seed + hex) and fails.
     python tools/fuzz.py --target hpack --iters 5000
 CI runs a smaller budget via tests/test_fuzz_parsers.py.
 
-Campaign log (round 2): 100,000 cases on each of the 10 targets, zero
-crashes. Initial runs found two real h2 bugs, both fixed: an IndexError
-on a PADDED/PRIORITY HEADERS frame with an empty payload, and
-pad-length/priority fields stripped in the wrong order vs RFC 7540 §6.2.
+Campaign log (round 2): 100,000 cases on each of the 14 targets, zero
+crashes at the end of the round. Along the way the campaigns found and
+fixed seven real bugs: two in h2 (IndexError on a PADDED/PRIORITY
+HEADERS frame with an empty payload; pad/priority fields stripped in
+the wrong order vs RFC 7540 §6.2), four in the bson codec (UnicodeDecodeError
+leaks, non-numeric array index keys, unbounded nesting recursion,
+datetime overflow), and one in the RTMP chunk demuxer (a header
+redefining the message length mid-message drove IOBuf.cutn negative and
+corrupted the buffer invariant).
 """
 
 from __future__ import annotations
