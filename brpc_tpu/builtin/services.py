@@ -9,10 +9,7 @@ from __future__ import annotations
 
 import json
 import os
-import sys
-import threading
 import time
-import traceback
 
 import brpc_tpu
 from brpc_tpu import flags as _flags
@@ -163,6 +160,17 @@ def connections_service(server, http: HttpMessage):
             lines.append(
                 f"{c.fd:<3} {str(c.remote):<21} {c.in_bytes:<9} "
                 f"{c.out_bytes:<10} {c.in_messages:<7} {c.out_messages}")
+        dp = getattr(server, "_native_dp", None)
+        if dp is not None:
+            with dp._lock:  # the native poller mutates _socks concurrently
+                native = [s for s in dp._socks.values()
+                          if s.owner_server is server]
+            if native:
+                lines.append("-- native engine conns --")
+            for s in sorted(native, key=lambda s: s.conn_id):
+                lines.append(
+                    f"c{s.conn_id:<2} {str(s.remote):<21} {s.in_bytes:<9} "
+                    f"{s.out_bytes:<10} {s.in_messages:<7} {s.out_messages}")
     return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
 
 
@@ -222,24 +230,23 @@ def fibers_service(server, http: HttpMessage):
     lines = [f"workers: {len(workers)}",
              f"tasks_executed: {tc.tasks_executed.get_value()}"]
     for w in workers:
+        cur = w.current
+        if cur is None:
+            state = " idle"
+        else:
+            fn = getattr(cur, "fn", None)
+            name = getattr(fn, "__qualname__", None) or repr(fn)
+            state = f" running={name}"
         lines.append(f"  worker[{w.index}] tag={w.tag} "
-                     f"queue={len(w.local)} alive={w.is_alive()}")
+                     f"queue={len(w.local)} alive={w.is_alive()}{state}")
     return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
 
 
 # -------------------------------------------------------------------- threads
 def threads_service(server, http: HttpMessage):
-    frames = sys._current_frames()
-    by_id = {t.ident: t for t in threading.enumerate()}
-    out = []
-    for tid, frame in frames.items():
-        t = by_id.get(tid)
-        name = t.name if t else f"tid{tid}"
-        out.append(f"-- {name} (tid={tid}) --")
-        out.extend(line.rstrip()
-                   for line in traceback.format_stack(frame))
-        out.append("")
-    return 200, CONTENT_TEXT, "\n".join(out) + "\n"
+    from brpc_tpu.butil.debug import dump_all_stacks
+
+    return 200, CONTENT_TEXT, dump_all_stacks()
 
 
 # --------------------------------------------------------------------- memory

@@ -111,6 +111,7 @@ class _Worker(threading.Thread):
         self.tag = tag
         self.local: deque = deque()
         self.lock = threading.Lock()
+        self.current: Optional[FiberTask] = None  # /fibers task visibility
 
     def run(self) -> None:
         control = self.control
@@ -126,7 +127,11 @@ class _Worker(threading.Thread):
                 lot.wait(expected, timeout=1.0)
                 continue
             control.tasks_executed.put(1)
-            task.run()
+            self.current = task
+            try:
+                task.run()
+            finally:
+                self.current = None
 
     def _next_task(self) -> Optional[FiberTask]:
         with self.lock:

@@ -217,8 +217,10 @@ class Server:
 
     # ----------------------------------------------------------- start/stop
     def start(self, address: str = "127.0.0.1:0") -> "Server":
+        from brpc_tpu.butil.debug import install_crash_handler
         from brpc_tpu.policy import ensure_registered
 
+        install_crash_handler()  # SIGSEGV/ABRT dump all stacks (butil/debug)
         ensure_registered()
         if "Health" not in self._services:
             # builtin grpc.health.v1.Health (reference server.cpp:499-601

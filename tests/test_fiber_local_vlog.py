@@ -134,3 +134,43 @@ class TestCollector:
             assert granted <= 10  # dump-side asks find it drained
         finally:
             cmod._collector = old
+
+
+class TestDebugKit:
+    def test_dump_all_stacks(self):
+        from brpc_tpu.butil.debug import dump_all_stacks
+
+        out = dump_all_stacks()
+        assert "MainThread" in out and "test_dump_all_stacks" in out
+
+    def test_crash_handler_idempotent(self, tmp_path):
+        import faulthandler
+
+        from brpc_tpu.butil import debug
+
+        debug.install_crash_handler(str(tmp_path / "crash.log"))
+        debug.install_crash_handler()  # second call is a no-op
+        assert faulthandler.is_enabled()
+
+    def test_fibers_endpoint_shows_running_task(self):
+        from brpc_tpu.builtin import dispatch
+        from brpc_tpu.policy.http_protocol import HttpMessage
+
+        gate = threading.Event()
+        started = threading.Event()
+
+        def busy_task():
+            started.set()
+            gate.wait(5)
+
+        t = runtime.start_background(busy_task)
+        try:
+            assert started.wait(5)
+            req = HttpMessage()
+            req.path = "/fibers"
+            status, _, body, *_ = dispatch(None, req)
+            text = body if isinstance(body, str) else body.decode()
+            assert status == 200 and "busy_task" in text
+        finally:
+            gate.set()
+            t.join(5)
