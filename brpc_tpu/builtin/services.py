@@ -162,9 +162,7 @@ def connections_service(server, http: HttpMessage):
                 f"{c.out_bytes:<10} {c.in_messages:<7} {c.out_messages}")
         dp = getattr(server, "_native_dp", None)
         if dp is not None:
-            with dp._lock:  # the native poller mutates _socks concurrently
-                native = [s for s in dp._socks.values()
-                          if s.owner_server is server]
+            native = dp.server_socks(server)
             if native:
                 lines.append("-- native engine conns --")
             for s in sorted(native, key=lambda s: s.conn_id):

@@ -27,7 +27,14 @@ def install_crash_handler(crash_log_path: Optional[str] = None) -> None:
     Keeps the file object alive for faulthandler's sake."""
     if _installed[0] and not crash_log_path:
         return
+    if not _installed[0] and not crash_log_path \
+            and faulthandler.is_enabled():
+        # the application armed faulthandler itself (own crash log):
+        # the default stderr install must not silently re-point it
+        _installed[0] = True
+        return
     stream = sys.stderr
+    old = None
     if crash_log_path:
         try:
             f = open(crash_log_path, "a")
@@ -38,18 +45,20 @@ def install_crash_handler(crash_log_path: Optional[str] = None) -> None:
             old = _crash_file[0]
             _crash_file[0] = f
             stream = f
-            if old is not None:
-                try:
-                    old.close()
-                except OSError:
-                    pass
     elif _crash_file[0] is not None:
         stream = _crash_file[0]
     try:
         faulthandler.enable(file=stream, all_threads=True)
         _installed[0] = True
     except (RuntimeError, ValueError):
-        pass  # no usable stderr (embedded interpreter)
+        return  # no usable stream; keep the previous arming intact
+    if old is not None:
+        # close the superseded crash file only AFTER faulthandler moved to
+        # the new one — never leave it armed on a closed/reused fd
+        try:
+            old.close()
+        except OSError:
+            pass
 
 
 def dump_all_stacks() -> str:

@@ -353,6 +353,13 @@ class NativeDataplane:
         with self._lock:
             return self._socks.get(conn_id)
 
+    def server_socks(self, server) -> list:
+        """Snapshot of this server's live engine conns (lock discipline
+        stays in one place — /connections and the idle sweep use this)."""
+        with self._lock:
+            return [s for s in self._socks.values()
+                    if s.owner_server is server]
+
     # ------------------------------------------------------------ poll loop
     def _protocols(self):
         if self._proto_trpc is None:

@@ -465,6 +465,10 @@ class Server:
                 with self._conn_lock:
                     idle = [c for c in self._connections
                             if now - c.last_active > limit]
+                if self._native_dp is not None:
+                    # the C++ engine's conns idle out under the same flag
+                    idle += [s for s in self._native_dp.server_socks(self)
+                             if now - s.last_active > limit]
                 for c in idle:
                     c.set_failed(errors.EFAILEDSOCKET,
                                  f"idle > {limit:.0f}s")

@@ -200,3 +200,58 @@ class TestStreaming:
         finally:
             server2.stop()
             server2.join(timeout=2)
+
+
+class TestStreamingOverNativeLanes:
+    """The same streaming semantics must hold on every transport lane:
+    native TCP engine and the native TPUC shm tunnel (TSTR frames ride
+    the tunnel byte stream like any other message)."""
+
+    @pytest.mark.parametrize("listen,native_client", [
+        ("127.0.0.1:0", True),            # native TCP lane
+        ("tpu://127.0.0.1:0/0", True),    # native shm tunnel lane
+        ("tpu://127.0.0.1:0/0", False),   # python client, native server
+    ])
+    def test_stream_echo_on_lane(self, listen, native_client):
+        from brpc_tpu.rpc import ChannelOptions
+        from brpc_tpu.rpc.native_transport import dataplane_available
+
+        if not dataplane_available():
+            pytest.skip("native engine unavailable")
+        from brpc_tpu.rpc import ServerOptions
+
+        impl = StreamingEchoService()
+        server = Server(ServerOptions(native_dataplane=True))
+        server.add_service(impl)
+        server.start(listen)
+        try:
+            got = []
+            done = threading.Event()
+
+            def on_received(sid, msgs):
+                got.extend(msgs)
+                if len(got) >= 8:
+                    done.set()
+
+            opts = StreamOptions(on_received=on_received)
+            sid = stream_create(opts)
+            cntl = Controller()
+            cntl.stream_id = sid
+            ch = Channel(ChannelOptions(
+                timeout_ms=10000,
+                native_transport=native_client)).init(
+                str(server.listen_endpoint()))
+            stub = Stub(ch, ECHO_DESC)
+            resp = stub.Echo(echo_pb2.EchoRequest(message="open"),
+                             controller=cntl)
+            assert resp.message == "stream-accepted"
+            payloads = [bytes([i]) * (1000 * (i + 1)) for i in range(8)]
+            for p in payloads:
+                assert stream_write(sid, p) == 0
+            assert done.wait(10), f"echoed {len(got)}/8"
+            assert sorted(len(g) for g in got) == sorted(
+                len(p) for p in payloads)
+            stream_close(sid)
+        finally:
+            server.stop()
+            server.join(timeout=2)
