@@ -353,6 +353,17 @@ class NativeDataplane:
         with self._lock:
             return self._socks.get(conn_id)
 
+    def conn_stats(self, conn_id: int):
+        """(in_bytes, out_bytes, in_msgs, out_msgs) straight from the
+        engine — counts traffic the Python side never sees (C++-answered
+        native services). None for unknown conns."""
+        outs = [ctypes.c_uint64() for _ in range(4)]
+        rc = self._lib.dp_conn_stats(self._rt, conn_id,
+                                     *[ctypes.byref(o) for o in outs])
+        if rc != 0:
+            return None
+        return tuple(o.value for o in outs)
+
     def server_socks(self, server) -> list:
         """Snapshot of this server's live engine conns (lock discipline
         stays in one place — /connections and the idle sweep use this)."""

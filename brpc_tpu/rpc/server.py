@@ -466,9 +466,19 @@ class Server:
                     idle = [c for c in self._connections
                             if now - c.last_active > limit]
                 if self._native_dp is not None:
-                    # the C++ engine's conns idle out under the same flag
-                    idle += [s for s in self._native_dp.server_socks(self)
-                             if now - s.last_active > limit]
+                    # the C++ engine's conns idle out under the same flag.
+                    # last_active only sees Python-side traffic, so consult
+                    # the ENGINE's message counters too: C++-answered
+                    # native-service traffic must keep the conn alive
+                    for s in self._native_dp.server_socks(self):
+                        stats = self._native_dp.conn_stats(s.conn_id)
+                        if stats is not None:
+                            total = stats[2] + stats[3]
+                            if total != getattr(s, "_sweep_msgs", -1):
+                                s._sweep_msgs = total
+                                s.last_active = now
+                        if now - s.last_active > limit:
+                            idle.append(s)
                 for c in idle:
                     c.set_failed(errors.EFAILEDSOCKET,
                                  f"idle > {limit:.0f}s")

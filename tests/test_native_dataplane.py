@@ -328,19 +328,35 @@ class TestTunnelStress:
             stub = _stub(server, native=True, timeout_ms=3000)
             stub.Echo(echo_pb2.EchoRequest(message="warm"))
             dp = server._native_dp
-            with dp._lock:
-                before = sum(1 for s in dp._socks.values()
-                             if s.owner_server is server)
-            assert before >= 1
+            assert len(dp.server_socks(server)) >= 1
             deadline = time.monotonic() + 12  # sweep ticks every 5s
             while time.monotonic() < deadline:
-                with dp._lock:
-                    left = sum(1 for s in dp._socks.values()
-                               if s.owner_server is server)
+                left = len(dp.server_socks(server))
                 if left == 0:
                     break
                 time.sleep(0.3)
             assert left == 0, f"{left} native conns survived the idle sweep"
+        finally:
+            server.stop()
+            server.join()
+
+    def test_cpp_fastpath_traffic_keeps_conn_alive(self):
+        """Traffic answered entirely in C++ never touches Python's
+        last_active — the sweep must consult the engine's counters, not
+        kill a busy conn (regression for the sweep's blind spot)."""
+        server = Server(ServerOptions(native_dataplane=True,
+                                      idle_timeout_s=1))
+        server.add_service(EchoImpl())
+        server.start("127.0.0.1:0")
+        server.register_native_echo("EchoService", "Echo")
+        try:
+            stub = _stub(server, native=True, timeout_ms=3000)
+            deadline = time.monotonic() + 7  # beyond limit + sweep tick
+            while time.monotonic() < deadline:
+                r = stub.Echo(echo_pb2.EchoRequest(message="alive"))
+                assert r.message == "alive"
+                time.sleep(0.05)
+            assert len(server._native_dp.server_socks(server)) >= 1
         finally:
             server.stop()
             server.join()
