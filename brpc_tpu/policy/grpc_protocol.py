@@ -232,6 +232,15 @@ class GrpcProtocol(Protocol):
         hdrs = dict(st.headers or [])
         path = hdrs.get(":path", "")
         parts = path.strip("/").split("/")
+        if not hdrs.get("content-type", "").startswith(CONTENT_GRPC):
+            # plain HTTP/2 request (browser/curl --http2): the builtin
+            # dashboard answers on h2 exactly like it does on HTTP/1.1
+            # (the reference serves /status etc. over h2 too). Dispatch on
+            # a fiber: builtins may block (e.g. /hotspots/cpu profiles for
+            # seconds) and must not stall this connection's frame parsing
+            runtime.start_background(self._serve_plain_http, conn, st,
+                                     hdrs)
+            return
         if hdrs.get(":method") != "POST" or len(parts) != 2:
             self._reject(conn, st.sid, G_UNIMPLEMENTED, f"bad path {path!r}")
             return
@@ -263,6 +272,44 @@ class GrpcProtocol(Protocol):
         from brpc_tpu.rpc.server_processing import process_rpc_request
 
         runtime.start_background(process_rpc_request, shim, msg, server)
+
+    def _serve_plain_http(self, conn: _h2.H2Conn, st: _h2.H2Stream,
+                          hdrs: dict) -> None:
+        """Builtin-dashboard dispatch for non-grpc h2 requests."""
+        import urllib.parse as _up
+
+        from brpc_tpu import builtin
+        from brpc_tpu.policy.http_protocol import HttpMessage
+
+        http = HttpMessage()
+        http.method = hdrs.get(":method", "GET")
+        http.uri = hdrs.get(":path", "/")
+        path, _, query = http.uri.partition("?")
+        http.path = path
+        http.query = dict(_up.parse_qsl(query))
+        http.headers = {k: v for k, v in (st.headers or [])
+                        if not k.startswith(":")}
+        http.body = bytes(st.data)
+        server = conn.sock.owner_server
+        try:
+            handled = builtin.dispatch(server, http)
+        except Exception as e:
+            handled = (500, "text/plain", f"builtin service failed: {e}\n",
+                       None)
+        if handled is None:
+            handled = (404, "text/plain",
+                       f"no such builtin path {http.path!r} "
+                       f"(rpc over h2 needs content-type {CONTENT_GRPC})\n",
+                       None)
+        status, ctype, body, extra = handled
+        if isinstance(body, str):
+            body = body.encode()
+        headers = [(":status", str(status)), ("content-type", ctype)]
+        if extra:
+            headers += [(str(k).lower(), str(v)) for k, v in extra.items()]
+        st.close_on_end = True  # pop only after the tail + END_STREAM flush
+        conn.send_headers(st.sid, headers, end_stream=False)
+        conn.send_data(st.sid, body, end_stream=True)
 
     def _reject(self, conn, sid, grpc_code, text) -> None:
         conn.send_headers(sid, [

@@ -91,7 +91,8 @@ def pack_settings(pairs: List[Tuple[int, int]], ack: bool = False) -> bytes:
 class H2Stream:
     __slots__ = ("sid", "headers", "trailers", "data", "recv_end",
                  "send_window", "pending", "pending_end", "end_sent", "rst",
-                 "headers_done", "recv_consumed", "user", "pending_trailers")
+                 "headers_done", "recv_consumed", "user", "pending_trailers",
+                 "close_on_end")
 
     def __init__(self, sid: int, send_window: int):
         self.sid = sid
@@ -108,6 +109,7 @@ class H2Stream:
         self.recv_consumed = 0
         self.user = None             # per-stream payload for the protocol
         self.pending_trailers = None  # trailers owed after pending drains
+        self.close_on_end = False    # auto-pop once END_STREAM flushed
 
 
 class H2Conn:
@@ -251,6 +253,11 @@ class H2Conn:
         if not st.pending and st.pending_trailers is not None:
             trailers, st.pending_trailers = st.pending_trailers, None
             self._emit_trailers_locked(st, trailers)
+        if st.end_sent and st.close_on_end:
+            # deferred close: only once the flow-controlled tail (and its
+            # END_STREAM) actually went out — an immediate close_stream
+            # would strand pending bytes when the peer's window is small
+            self.streams.pop(st.sid, None)
         return rc
 
     def _flush_all_locked(self) -> None:

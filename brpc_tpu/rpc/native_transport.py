@@ -91,6 +91,7 @@ class NativeSocket:
         self.in_messages = 0
         self.out_messages = 0
         self.last_active = _time.monotonic()
+        self._sweep_msgs = 0  # engine-counter baseline for the idle sweep
         self._pending_ids: Set[int] = set()
         self._pending_lock = threading.Lock()
         self.on_failed_hook = None

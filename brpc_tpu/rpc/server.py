@@ -474,7 +474,7 @@ class Server:
                         stats = self._native_dp.conn_stats(s.conn_id)
                         if stats is not None:
                             total = stats[2] + stats[3]
-                            if total != getattr(s, "_sweep_msgs", -1):
+                            if total != s._sweep_msgs:
                                 s._sweep_msgs = total
                                 s.last_active = now
                         if now - s.last_active > limit:

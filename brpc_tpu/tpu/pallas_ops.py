@@ -64,3 +64,119 @@ def rmsnorm(x, w, eps: float = EPS, interpret: bool = None,
         interpret=interpret,
     )(x2, w)
     return out[:N].reshape(orig_shape)
+
+
+# ---------------------------------------------------------------------------
+# Flash attention — tiled online-softmax attention (the canonical TPU
+# kernel: never materializes the S x S score matrix; K/V stream through
+# VMEM tiles while running max/denominator accumulators live in scratch
+# persisted across the innermost grid dimension).
+# ---------------------------------------------------------------------------
+NEG_INF = -1e30
+
+
+def attention_reference(q, k, v, causal: bool = False):
+    """O(S^2)-memory reference for numerics tests."""
+    s = jnp.einsum("qd,kd->qk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / jnp.sqrt(q.shape[-1])
+    if causal:
+        mask = jnp.tril(jnp.ones(s.shape, dtype=bool))
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("qk,kd->qd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  causal: bool, bq: int, bk: int, nk: int):
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(0)
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _accumulate():
+        q = q_ref[:].astype(jnp.float32)
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        s = jnp.dot(q, k.T) / jnp.sqrt(jnp.float32(q.shape[-1]))
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32,
+                                                       (bq, bk), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32,
+                                                       (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(p, v)
+        m_scr[:] = m_new
+
+    if causal:
+        # tiles fully above the diagonal contribute nothing — skip them
+        @pl.when(qi * bq + bq - 1 >= ki * bk)
+        def _():
+            _accumulate()
+    else:
+        _accumulate()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        # fully-masked rows (l == 0) normalize to zeros, not NaNs
+        l = l_scr[:]
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[:] = (acc_scr[:] / safe).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret"))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = None):
+    """Single-head flash attention over (S, D) tensors; vmap for heads/
+    batch. Sequence length must divide by the block sizes (pad upstream —
+    the ring-attention layer already block-aligns its shards)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = not _on_tpu()
+    sq, d = q.shape
+    sk = k.shape[0]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    if sq % bq or sk % bk:
+        raise ValueError(f"seq lengths ({sq},{sk}) must divide blocks "
+                         f"({bq},{bk})")
+    nq, nk = sq // bq, sk // bk
+    kernel = functools.partial(_flash_kernel, causal=causal, bq=bq, bk=bk,
+                               nk=nk)
+    return pl.pallas_call(
+        kernel,
+        grid=(nq, nk),
+        in_specs=[
+            pl.BlockSpec((bq, d), lambda qi, ki: (qi, 0)),
+            pl.BlockSpec((bk, d), lambda qi, ki: (ki, 0)),
+            pl.BlockSpec((bk, d), lambda qi, ki: (ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((bq, d), lambda qi, ki: (qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def flash_attention_mha(q, k, v, causal: bool = False, **kw):
+    """(B, H, S, D) multi-head wrapper: vmapped flash_attention."""
+    f = functools.partial(flash_attention, causal=causal, **kw)
+    return jax.vmap(jax.vmap(f))(q, k, v)

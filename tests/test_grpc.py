@@ -339,3 +339,51 @@ class TestGrpcHealth:
         assert resp.status == health_pb2.HealthCheckResponse.SERVING
         resp = stub.Check(health_pb2.HealthCheckRequest(service="Nope"))
         assert resp.status == health_pb2.HealthCheckResponse.SERVICE_UNKNOWN
+
+
+class TestPlainHttp2Dashboard:
+    def test_h2_get_health(self, grpc_server):
+        """A non-grpc HTTP/2 GET (curl --http2 style) reaches the builtin
+        dashboard on the same port."""
+        import socket as _socket
+        import struct as _struct
+
+        from brpc_tpu.policy.h2 import PREFACE, pack_frame, pack_settings
+        from brpc_tpu.policy.hpack import HpackDecoder, HpackEncoder
+
+        server, _impl = grpc_server
+        ep = server.listen_endpoint()
+        enc = HpackEncoder()
+        hdrs = enc.encode([(":method", "GET"), (":scheme", "http"),
+                           (":path", "/health"), (":authority", "t")])
+        with _socket.create_connection((ep.host, ep.port), timeout=5) as s:
+            s.sendall(PREFACE + pack_settings([]) +
+                      pack_frame(1, 0x4 | 0x1, 1, hdrs))
+            s.settimeout(5)
+            buf = b""
+            status = None
+            body = b""
+            dec = HpackDecoder()
+            done = False
+            while not done:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                while len(buf) >= 9:
+                    ln = (buf[0] << 16) | (buf[1] << 8) | buf[2]
+                    if len(buf) < 9 + ln:
+                        break
+                    ftype, flags = buf[3], buf[4]
+                    payload = buf[9:9 + ln]
+                    buf = buf[9 + ln:]
+                    if ftype == 1:  # HEADERS
+                        got = dict(dec.decode(payload))
+                        status = got.get(":status")
+                    elif ftype == 0:  # DATA
+                        body += payload
+                        if flags & 0x1:
+                            done = True
+                            break
+        assert status == "200", status
+        assert body  # /health answered over plain h2

@@ -242,3 +242,71 @@ class TestTrain:
                 lambda p, t: train.forward(p, t, cfg, mesh=m))(params, tokens)
         np.testing.assert_allclose(np.asarray(sharded), np.asarray(ref),
                                    rtol=5e-4, atol=5e-5)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        import jax
+
+        from brpc_tpu.tpu.pallas_ops import (attention_reference,
+                                             flash_attention)
+
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        S, D = 256, 64
+        q = jax.random.normal(kq, (S, D), dtype=jnp.float32)
+        k = jax.random.normal(kk, (S, D), dtype=jnp.float32)
+        v = jax.random.normal(kv, (S, D), dtype=jnp.float32)
+        out = flash_attention(q, k, v, causal=causal, block_q=64,
+                              block_k=64, interpret=True)
+        ref = attention_reference(q, k, v, causal=causal)
+        assert jnp.allclose(out, ref, atol=2e-3), float(
+            jnp.abs(out - ref).max())
+
+    def test_multi_head(self):
+        import jax
+
+        from brpc_tpu.tpu.pallas_ops import (attention_reference,
+                                             flash_attention_mha)
+
+        key = jax.random.PRNGKey(1)
+        B, H, S, D = 2, 4, 128, 32
+        q, k, v = (jax.random.normal(kk, (B, H, S, D), dtype=jnp.float32)
+                   for kk in jax.random.split(key, 3))
+        out = flash_attention_mha(q, k, v, causal=True, block_q=64,
+                                  block_k=64, interpret=True)
+        for b in range(B):
+            for h in range(H):
+                ref = attention_reference(q[b, h], k[b, h], v[b, h],
+                                          causal=True)
+                assert jnp.allclose(out[b, h], ref, atol=2e-3)
+
+    def test_block_misalignment_rejected(self):
+        import jax
+
+        from brpc_tpu.tpu.pallas_ops import flash_attention
+
+        q = jnp.zeros((100, 32))
+        with pytest.raises(ValueError):
+            flash_attention(q, q, q, block_q=64, block_k=64,
+                            interpret=True)
+
+    def test_flash_attention_on_hardware(self):
+        """Exercise the NATIVE Mosaic lowering (scratch shapes, tiling) —
+        interpret mode can hide hardware constraints. bf16 MXU matmuls
+        give ~1e-2 error vs the fp32 reference at D=128."""
+        import jax
+
+        if jax.default_backend() != "tpu":
+            pytest.skip("no TPU backend")
+        from brpc_tpu.tpu.pallas_ops import (attention_reference,
+                                             flash_attention)
+
+        key = jax.random.PRNGKey(2)
+        q, k, v = (jax.random.normal(kk, (256, 128), dtype=jnp.float32)
+                   for kk in jax.random.split(key, 3))
+        out = flash_attention(q, k, v, causal=True, interpret=False)
+        ref = attention_reference(q, k, v, causal=True)
+        assert jnp.allclose(out, ref, atol=2e-2), float(
+            jnp.abs(out - ref).max())
