@@ -220,7 +220,10 @@ class H2Conn:
             st = self.streams.get(sid)
             if st is None or st.rst:
                 return 0
-            st.pending.append(memoryview(bytes(data)))
+            if data:
+                st.pending.append(memoryview(bytes(data)))
+            # empty payloads only carry END_STREAM — a zero-length pending
+            # head would wedge the flush loop (allowed=0) and never emit it
             if end_stream:
                 st.pending_end = True
             return self._flush_stream_locked(st)

@@ -175,6 +175,21 @@ def process_http_request(msg, server) -> None:
             _rpc_error_reply(sock, http, cntl.error_code, cntl.error_text(),
                              as_json)
             return _settle(cntl.error_code)
+        pa = getattr(cntl, "_progressive", None)
+        if pa is not None:
+            # streamed body (reference progressive_attachment.cpp): chunked
+            # headers now, chunks from the attachment — the pb response is
+            # NOT serialized into the body
+            from brpc_tpu.rpc.progressive import render_chunked_headers
+
+            ctype = http.header("accept") or "application/octet-stream"
+            if "," in ctype or ctype == "*/*":
+                ctype = "application/octet-stream"
+            sock.write(render_chunked_headers(200, ctype,
+                                              keep_alive=http.keep_alive()))
+            sock.out_messages += 1
+            pa._start(sock)
+            return _settle(errors.OK)
         extra = {}
         cid = http.header(H_CID)
         if cid:

@@ -313,6 +313,22 @@ class Controller:
         return _cid.id_join(self._call_id, timeout)
 
     # ============================================================ server role
+    def create_progressive_attachment(self):
+        """Server-side, HTTP only: stream the response body in chunks after
+        the RPC completes (reference Controller::CreateProgressiveAttachment,
+        progressive_attachment.cpp). The pb response is not serialized into
+        the body; chunks written to the returned object ARE the body."""
+        if not self.is_server_side or self.http_request is None:
+            # the reference returns NULL off-HTTP; silently buffering data
+            # that no response path will ever flush is worse than failing
+            raise ValueError("progressive attachments are HTTP-only "
+                             "(this request arrived via a binary protocol)")
+        from brpc_tpu.rpc.progressive import ProgressiveAttachment
+
+        pa = ProgressiveAttachment()
+        self._progressive = pa
+        return pa
+
     @classmethod
     def server_controller(cls, server, sock, meta) -> "Controller":
         c = cls()

@@ -42,6 +42,8 @@ class ModelConfig:
     max_seq: int = 512
     dtype: Any = jnp.float32
     use_pallas_norm: bool = False  # flip on for TPU runs
+    use_flash_attention: bool = False  # Pallas flash kernel (single-device
+    #                                    path; needs S % 128 == 0)
 
     @property
     def head_dim(self) -> int:
@@ -126,6 +128,14 @@ def forward(params, tokens, cfg: ModelConfig, mesh: Mesh = None,
             v = constrain(v, "dp", "sp", "tp", None)
             att = ring_attention(q, k, v, mesh, axis="sp", causal=causal,
                                  batch_axis="dp", head_axis="tp")
+        elif cfg.use_flash_attention:
+            from brpc_tpu.tpu.pallas_ops import flash_attention_mha
+
+            # [B,S,H,Dh] -> [B,H,S,Dh] for the per-head kernel
+            att = flash_attention_mha(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), causal=causal,
+            ).transpose(0, 2, 1, 3).astype(cfg.dtype)
         else:
             from brpc_tpu.tpu.ring import full_attention_reference
 

@@ -343,3 +343,111 @@ class TestRpcDump:
                 server.join(timeout=2)
         finally:
             _flags.set_flag("rpc_dump_ratio", "0.0")
+
+
+class TestProgressiveAttachment:
+    def test_chunked_streaming_download(self):
+        """Handler finishes the RPC, then streams body chunks from another
+        thread (reference progressive_attachment.cpp); the client sees the
+        assembled chunked body and the connection stays keep-alive."""
+        import socket as _socket
+        import threading
+
+        from brpc_tpu.proto import echo_pb2
+        from brpc_tpu.rpc import Server, Service
+
+        chunks = [b"alpha-", b"beta-", b"g" * 5000, b"-end"]
+        started = threading.Event()
+
+        class Downloader(Service):
+            DESCRIPTOR = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+            def Echo(self, cntl, request, done):
+                pa = cntl.create_progressive_attachment()
+                assert pa.write(chunks[0]) == 0  # buffered pre-headers
+
+                def pump():
+                    started.wait(5)
+                    for c in chunks[1:]:
+                        assert pa.write(c) == 0
+                    assert pa.close() == 0
+
+                threading.Thread(target=pump, daemon=True).start()
+                return echo_pb2.EchoResponse(message="ignored")
+
+        server = Server().add_service(Downloader()).start("127.0.0.1:0")
+        try:
+            ep = server.listen_endpoint()
+            with _socket.create_connection((ep.host, ep.port),
+                                           timeout=5) as s:
+                s.sendall(b"POST /EchoService/Echo HTTP/1.1\r\n"
+                          b"Host: t\r\nContent-Type: application/json\r\n"
+                          b"Content-Length: 2\r\n\r\n{}")
+                s.settimeout(5)
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    data += s.recv(4096)
+                head, _, rest = data.partition(b"\r\n\r\n")
+                assert b"Transfer-Encoding: chunked" in head
+                started.set()  # let the pump stream the remaining chunks
+                while b"0\r\n\r\n" not in rest:
+                    chunk = s.recv(4096)
+                    if not chunk:
+                        break
+                    rest += chunk
+                # decode chunked framing
+                body = b""
+                pos = 0
+                while True:
+                    nl = rest.index(b"\r\n", pos)
+                    size = int(rest[pos:nl], 16)
+                    if size == 0:
+                        break
+                    body += rest[nl + 2:nl + 2 + size]
+                    pos = nl + 2 + size + 2
+                assert body == b"".join(chunks)
+                # keep-alive: the SAME connection serves another request
+                s.sendall(b"GET /health HTTP/1.1\r\nHost: t\r\n\r\n")
+                more = s.recv(4096)
+                assert more.startswith(b"HTTP/1.1 200")
+        finally:
+            server.stop()
+            server.join(timeout=2)
+
+    def test_write_after_close_rejected(self):
+        from brpc_tpu.rpc import errors
+        from brpc_tpu.rpc.progressive import ProgressiveAttachment
+
+        pa = ProgressiveAttachment()
+        pa.write(b"x")
+        pa.close()
+        assert pa.write(b"y") == errors.ESTREAMCLOSED
+
+    def test_progressive_rejected_on_binary_protocol(self):
+        from brpc_tpu.proto import echo_pb2
+        from brpc_tpu.rpc import (Channel, ChannelOptions, Server, Service,
+                                  Stub)
+
+        seen = {}
+
+        class Svc(Service):
+            DESCRIPTOR = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+            def Echo(self, cntl, request, done):
+                try:
+                    cntl.create_progressive_attachment()
+                    seen["raised"] = False
+                except ValueError:
+                    seen["raised"] = True
+                return echo_pb2.EchoResponse(message="ok")
+
+        server = Server().add_service(Svc()).start("127.0.0.1:0")
+        try:
+            ch = Channel(ChannelOptions(timeout_ms=3000))
+            ch.init(str(server.listen_endpoint()))
+            stub = Stub(ch, echo_pb2.DESCRIPTOR.services_by_name["EchoService"])
+            assert stub.Echo(echo_pb2.EchoRequest(message="x")).message == "ok"
+            assert seen["raised"] is True
+        finally:
+            server.stop()
+            server.join(timeout=2)

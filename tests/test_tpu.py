@@ -310,3 +310,22 @@ class TestFlashAttention:
         ref = attention_reference(q, k, v, causal=True)
         assert jnp.allclose(out, ref, atol=2e-2), float(
             jnp.abs(out - ref).max())
+
+
+class TestFlashInModel:
+    def test_forward_matches_reference_attention(self):
+        import jax
+
+        from brpc_tpu.tpu import train
+
+        cfg_ref = train.ModelConfig(vocab=64, d_model=64, n_heads=2,
+                                    n_layers=2, d_ff=128, max_seq=128)
+        cfg_flash = train.ModelConfig(vocab=64, d_model=64, n_heads=2,
+                                      n_layers=2, d_ff=128, max_seq=128,
+                                      use_flash_attention=True)
+        params = train.init_params(jax.random.PRNGKey(0), cfg_ref)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 128), 0, 64)
+        ref = train.forward(params, tokens, cfg_ref)
+        out = train.forward(params, tokens, cfg_flash)
+        assert jnp.allclose(out, ref, atol=3e-3), float(
+            jnp.abs(out - ref).max())
