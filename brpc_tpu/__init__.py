@@ -20,3 +20,57 @@ Layers (mirrors SURVEY.md §1):
 """
 
 __version__ = "0.1.0"
+
+# ------------------------------------------------------------ public surface
+# The front door mirrors the reference's brpc/ headers: everything a user of
+# channel.h/server.h/stream.h/parallel_channel.h reaches for, importable
+# from the package root. (brpc_tpu.tpu is imported explicitly — it pulls in
+# jax, which the RPC core does not need.)
+from brpc_tpu.rpc import (  # noqa: E402
+    Channel,
+    ChannelOptions,
+    Controller,
+    GenericService,
+    MethodDescriptor,
+    RawMessage,
+    RpcError,
+    Server,
+    ServerOptions,
+    Service,
+    Stub,
+    errors,
+)
+from brpc_tpu.rpc.combo_channels import (  # noqa: E402
+    SKIP,
+    CallMapper,
+    DynamicPartitionChannel,
+    ParallelChannel,
+    PartitionChannel,
+    PartitionParser,
+    ResponseMerger,
+    SelectiveChannel,
+)
+from brpc_tpu.rpc.ssl_helper import (  # noqa: E402
+    ClientSslOptions,
+    ServerSslOptions,
+)
+from brpc_tpu.rpc.stream import (  # noqa: E402
+    StreamOptions,
+    stream_accept,
+    stream_close,
+    stream_create,
+    stream_write,
+)
+
+__all__ = [
+    "__version__",
+    "Channel", "ChannelOptions", "Controller", "GenericService",
+    "MethodDescriptor", "RawMessage", "RpcError", "Server", "ServerOptions",
+    "Service", "Stub", "errors",
+    "SKIP", "CallMapper", "DynamicPartitionChannel", "ParallelChannel",
+    "PartitionChannel", "PartitionParser", "ResponseMerger",
+    "SelectiveChannel",
+    "ClientSslOptions", "ServerSslOptions",
+    "StreamOptions", "stream_accept", "stream_close", "stream_create",
+    "stream_write",
+]

@@ -222,18 +222,21 @@ def parse_http_message(buf: IOBuf) -> Tuple[int, Optional[HttpMessage]]:
 
 def render_response(status: int, content_type: str, body,
                     extra_headers: Optional[Dict[str, str]] = None,
-                    keep_alive: bool = True) -> bytes:
+                    keep_alive: bool = True, chunked: bool = False) -> bytes:
+    """chunked=True emits Transfer-Encoding: chunked headers with NO body
+    (the caller streams chunks afterwards — progressive attachments)."""
     if isinstance(body, str):
         body = body.encode("utf-8")
     reason = _STATUS_REASON.get(status, "Unknown")
     lines = [f"HTTP/1.1 {status} {reason}",
              f"Content-Type: {content_type}",
-             f"Content-Length: {len(body)}",
+             ("Transfer-Encoding: chunked" if chunked
+              else f"Content-Length: {len(body)}"),
              "Connection: " + ("keep-alive" if keep_alive else "close")]
     for k, v in (extra_headers or {}).items():
         lines.append(f"{k}: {v}")
     head = ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1")
-    return head + body
+    return head if chunked else head + body
 
 
 def render_request(method: str, path: str, host: str, body: bytes = b"",

@@ -179,16 +179,23 @@ def process_http_request(msg, server) -> None:
         if pa is not None:
             # streamed body (reference progressive_attachment.cpp): chunked
             # headers now, chunks from the attachment — the pb response is
-            # NOT serialized into the body
+            # NOT serialized into the body. HTTP/1.0 peers don't understand
+            # chunked framing at all — reject rather than corrupt
             from brpc_tpu.rpc.progressive import render_chunked_headers
 
+            if http.version == "HTTP/1.0":
+                _rpc_error_reply(sock, http, errors.EREQUEST,
+                                 "progressive responses need HTTP/1.1",
+                                 as_json)
+                return _settle(errors.EREQUEST)
+            keep = http.keep_alive()
             ctype = http.header("accept") or "application/octet-stream"
             if "," in ctype or ctype == "*/*":
                 ctype = "application/octet-stream"
-            sock.write(render_chunked_headers(200, ctype,
-                                              keep_alive=http.keep_alive()))
+            sock.write(render_chunked_headers(200, ctype, keep_alive=keep))
             sock.out_messages += 1
-            pa._start(sock)
+            # pa closes the socket after the terminator when keep is False
+            pa._start(sock, keep_alive=keep)
             return _settle(errors.OK)
         extra = {}
         cid = http.header(H_CID)

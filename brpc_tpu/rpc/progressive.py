@@ -33,11 +33,14 @@ class ProgressiveAttachment:
         self._buffered = []           # writes before the headers went out
         self._closed = False
         self._started = False
+        self._keep_alive = True
 
     # ------------------------------------------------------------ user side
     def write(self, data) -> int:
         """Queue/send one chunk. 0 on success; EFAILEDSOCKET/ESTREAMCLOSED
-        when the connection died or close() already ran."""
+        when the connection died or close() already ran. The socket write
+        happens UNDER the lock (it queues, never blocks) so a concurrent
+        close() cannot put its terminator ahead of this chunk."""
         data = bytes(data)
         if not data:
             return 0
@@ -48,12 +51,13 @@ class ProgressiveAttachment:
                 self._buffered.append(data)
                 return 0
             sock = self._sock
-        if sock is None or sock.failed:
-            return errors.EFAILEDSOCKET
-        return sock.write(_chunk(data))
+            if sock is None or sock.failed:
+                return errors.EFAILEDSOCKET
+            return sock.write(_chunk(data))
 
     def close(self) -> int:
-        """Terminal 0-size chunk; the connection stays keep-alive."""
+        """Terminal 0-size chunk; the connection stays keep-alive unless
+        the request asked for Connection: close."""
         with self._lock:
             if self._closed:
                 return 0
@@ -61,16 +65,19 @@ class ProgressiveAttachment:
             if not self._started:
                 return 0  # _start flushes buffer + terminator
             sock = self._sock
-        if sock is None or sock.failed:
-            return errors.EFAILEDSOCKET
-        return sock.write(b"0\r\n\r\n")
+            if sock is None or sock.failed:
+                return errors.EFAILEDSOCKET
+            rc = sock.write(b"0\r\n\r\n")
+            if not self._keep_alive:
+                sock.close()
+            return rc
 
     @property
     def closed(self) -> bool:
         return self._closed
 
     # ------------------------------------------------------- framework side
-    def _start(self, sock) -> None:
+    def _start(self, sock, keep_alive: bool = True) -> None:
         """Called by the HTTP response path once the chunked headers are on
         the wire: flush buffered writes (and the terminator if the handler
         already closed). The flush happens UNDER the lock — a pump thread
@@ -78,24 +85,22 @@ class ProgressiveAttachment:
         buffered ones (sock.write never blocks: it queues)."""
         with self._lock:
             self._sock = sock
+            self._keep_alive = keep_alive
             buffered, self._buffered = self._buffered, []
             for data in buffered:
                 sock.write(_chunk(data))
             if self._closed:
                 sock.write(b"0\r\n\r\n")
+                if not keep_alive:
+                    sock.close()
             self._started = True
 
 
 def render_chunked_headers(status: int, content_type: str,
                            extra_headers: Optional[dict] = None,
                            keep_alive: bool = True) -> bytes:
-    from brpc_tpu.policy.http_protocol import _STATUS_REASON
+    from brpc_tpu.policy.http_protocol import render_response
 
-    reason = _STATUS_REASON.get(status, "Unknown")
-    lines = [f"HTTP/1.1 {status} {reason}",
-             f"Content-Type: {content_type}",
-             "Transfer-Encoding: chunked",
-             "Connection: " + ("keep-alive" if keep_alive else "close")]
-    for k, v in (extra_headers or {}).items():
-        lines.append(f"{k}: {v}")
-    return ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1")
+    return render_response(status, content_type, b"",
+                           extra_headers=extra_headers,
+                           keep_alive=keep_alive, chunked=True)

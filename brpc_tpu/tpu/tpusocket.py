@@ -143,9 +143,13 @@ class TpuSocket:
         with self._pending_lock:
             self._pending_ids.add(cid)
 
-    def remove_pending_id(self, cid: int) -> None:
+    def remove_pending_id(self, cid: int) -> bool:
+        """True iff the entry was present (caller owns its error delivery)."""
         with self._pending_lock:
-            self._pending_ids.discard(cid)
+            if cid in self._pending_ids:
+                self._pending_ids.discard(cid)
+                return True
+            return False
 
     def write(self, data, id_wait: Optional[int] = None) -> int:
         if self.failed:
@@ -191,8 +195,9 @@ class TpuSocket:
     def _run_one(self, packet: IOBuf) -> None:
         from brpc_tpu.policy.trpc_std import TrpcStdProtocol
         from brpc_tpu.rpc.controller import handle_response_message
+        from brpc_tpu.rpc.protocol import find_protocol
 
-        proto = TrpcStdProtocol()
+        proto = find_protocol("trpc_std") or TrpcStdProtocol()
         rc, msg = proto.parse(packet)
         if msg is None:
             return
