@@ -134,8 +134,11 @@ def load_dataplane() -> Optional[ctypes.CDLL]:
         lib.dp_listen_port.restype = ctypes.c_int
         lib.dp_listen_port.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.dp_register_echo.restype = ctypes.c_int
-        lib.dp_register_echo.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                         ctypes.c_char_p]
+        lib.dp_register_echo.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                         ctypes.c_char_p, ctypes.c_char_p]
+        lib.dp_unregister_listener_echoes.restype = ctypes.c_int
+        lib.dp_unregister_listener_echoes.argtypes = [ctypes.c_void_p,
+                                                      ctypes.c_int]
         lib.dp_connect.restype = ctypes.c_uint64
         lib.dp_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                    ctypes.c_int, ctypes.c_int,

@@ -402,8 +402,14 @@ struct Runtime {
   std::deque<DpEvent> events;
   uint64_t event_bytes = 0;
 
+  struct EchoSvc {
+    int lid;  // native services are scoped to their listener — one
+              // server's fast path must not answer another's traffic
+    std::string service;
+    std::string method;
+  };
   std::mutex rmu;  // native service registry
-  std::vector<std::pair<std::string, std::string>> echo_services;
+  std::vector<EchoSvc> echo_services;
 };
 
 // ------------------------------------------------------------------ helpers
@@ -753,10 +759,13 @@ void conn_drain_writes(Runtime* rt, const std::shared_ptr<Conn>& c) {
 }
 
 // ----------------------------------------------------------------- parsing
-bool echo_match(Runtime* rt, const MetaLite& m) {
+bool echo_match(Runtime* rt, int lid, const MetaLite& m) {
+  if (lid < 0) return false;
   std::lock_guard<std::mutex> lk(rt->rmu);
   for (auto& sm : rt->echo_services) {
-    if (sm.first == m.service && sm.second == m.method) return true;
+    if (sm.lid == lid && sm.service == m.service && sm.method == m.method) {
+      return true;
+    }
   }
   return false;
 }
@@ -772,7 +781,7 @@ bool try_native_echo(Runtime* rt, const std::shared_ptr<Conn>& c,
     return false;
   }
   if (m.attachment_size > body_len) return false;
-  if (!echo_match(rt, m)) return false;
+  if (!echo_match(rt, c->listener_id, m)) return false;
   std::string head;
   {
     std::string meta = build_echo_response_meta(m);
@@ -1667,10 +1676,25 @@ int dp_listen_port(void* h, int lid) {
   return rt->listeners[size_t(lid)].port;
 }
 
-int dp_register_echo(void* h, const char* service, const char* method) {
+int dp_register_echo(void* h, int lid, const char* service,
+                     const char* method) {
+  auto* rt = static_cast<Runtime*>(h);
+  if (lid < 0) return -1;
+  std::lock_guard<std::mutex> lk(rt->rmu);
+  rt->echo_services.push_back({lid, service, method});
+  return 0;
+}
+
+// drop a listener's native services (Server teardown)
+int dp_unregister_listener_echoes(void* h, int lid) {
   auto* rt = static_cast<Runtime*>(h);
   std::lock_guard<std::mutex> lk(rt->rmu);
-  rt->echo_services.emplace_back(service, method);
+  rt->echo_services.erase(
+      std::remove_if(rt->echo_services.begin(), rt->echo_services.end(),
+                     [lid](const Runtime::EchoSvc& e) {
+                       return e.lid == lid;
+                     }),
+      rt->echo_services.end());
   return 0;
 }
 

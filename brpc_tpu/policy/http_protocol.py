@@ -227,6 +227,9 @@ def render_response(status: int, content_type: str, body,
     (the caller streams chunks afterwards — progressive attachments)."""
     if isinstance(body, str):
         body = body.encode("utf-8")
+    if chunked and body:
+        raise ValueError("chunked=True renders headers only; the caller "
+                         "streams the body as chunks")
     reason = _STATUS_REASON.get(status, "Unknown")
     lines = [f"HTTP/1.1 {status} {reason}",
              f"Content-Type: {content_type}",

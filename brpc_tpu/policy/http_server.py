@@ -184,6 +184,8 @@ def process_http_request(msg, server) -> None:
             from brpc_tpu.rpc.progressive import render_chunked_headers
 
             if http.version == "HTTP/1.0":
+                pa._abort()  # pump threads must see ESTREAMCLOSED, not
+                #              buffer into a response that never starts
                 _rpc_error_reply(sock, http, errors.EREQUEST,
                                  "progressive responses need HTTP/1.1",
                                  as_json)

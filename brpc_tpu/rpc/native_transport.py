@@ -267,6 +267,7 @@ class NativeDataplane:
     def teardown_listener(self, lid: int) -> None:
         """Drop the listener's registry entries and close its connections
         (Server.join after in-flight work drained)."""
+        self._lib.dp_unregister_listener_echoes(self._rt, lid)
         with self._lock:
             self._servers.pop(lid, None)
             conn_ids = list(self._server_conns.pop(lid, ()))
@@ -281,8 +282,10 @@ class NativeDataplane:
         self.stop_listening(lid)
         self.teardown_listener(lid)
 
-    def register_echo(self, service: str, method: str) -> None:
-        self._lib.dp_register_echo(self._rt, service.encode(),
+    def register_echo(self, lid: int, service: str, method: str) -> None:
+        """Native services are LISTENER-scoped: one server's C++ fast path
+        must never answer another server's traffic in the same process."""
+        self._lib.dp_register_echo(self._rt, lid, service.encode(),
                                    method.encode())
 
     def connect(self, ep: EndPoint, timeout_ms: int = 3000) -> NativeSocket:

@@ -69,12 +69,22 @@ class ProgressiveAttachment:
                 return errors.EFAILEDSOCKET
             rc = sock.write(b"0\r\n\r\n")
             if not self._keep_alive:
-                sock.close()
+                # drain-then-close: an immediate close would drop queued
+                # tail chunks (Socket.write queues past EAGAIN)
+                sock.graceful_close()
             return rc
 
     @property
     def closed(self) -> bool:
         return self._closed
+
+    def _abort(self) -> None:
+        """The response was rejected before headers (e.g. HTTP/1.0 peer):
+        further writes must fail fast, not buffer forever."""
+        with self._lock:
+            self._closed = True
+            self._started = True
+            self._buffered.clear()
 
     # ------------------------------------------------------- framework side
     def _start(self, sock, keep_alive: bool = True) -> None:
@@ -92,7 +102,7 @@ class ProgressiveAttachment:
             if self._closed:
                 sock.write(b"0\r\n\r\n")
                 if not keep_alive:
-                    sock.close()
+                    sock.graceful_close()
             self._started = True
 
 

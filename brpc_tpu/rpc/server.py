@@ -293,7 +293,7 @@ class Server:
         self._running = True
         self._logoff = False
         for svc, method in self._native_echoes:
-            dp.register_echo(svc, method)
+            dp.register_echo(self._native_lid, svc, method)
         self._schedule_idle_sweep()
         return True
 
@@ -305,8 +305,9 @@ class Server:
         like a reference service that bypasses ServerOptions hooks. Only
         meaningful with ``native_dataplane=True``."""
         self._native_echoes.append((service_name, method_name))
-        if getattr(self, "_native_dp", None) is not None:
-            self._native_dp.register_echo(service_name, method_name)
+        if self._native_dp is not None and self._native_lid is not None:
+            self._native_dp.register_echo(self._native_lid, service_name,
+                                          method_name)
 
     def adopt_connection(self, pysock, initial_bytes: bytes = b"",
                          dispatcher=None) -> None:

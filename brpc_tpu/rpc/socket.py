@@ -73,6 +73,7 @@ class Socket:
         self.socket_id = _socket_pool.insert(self)
         self._on_readable = on_readable
         self._close_lock = threading.Lock()
+        self._close_after_drain = False
         # invoked once from set_failed — transports layered on this socket
         # (tpu tunnel endpoints) tear down with it
         self.on_failed_hook = None
@@ -176,7 +177,8 @@ class Socket:
                 if not self._write_queue:
                     self._write_registered = False
                     self.dispatcher.disable_write(self.fd)
-                    return
+                    close_now = self._close_after_drain
+                    break
                 head = self._write_queue[0]
             try:
                 n = self._sock.send(head)
@@ -198,9 +200,21 @@ class Socket:
                     self._write_queue.popleft()
                 else:
                     self._write_queue[0] = head[n:]
+        if close_now:
+            self.close()
 
     def _on_writable(self) -> None:
         self._drain_write_queue()
+
+    def graceful_close(self) -> None:
+        """Close AFTER the write queue drains — an immediate close() drops
+        queued bytes on the floor (progressive responses with
+        Connection: close need their tail chunks delivered first)."""
+        with self._write_lock:
+            if self._write_queue:
+                self._close_after_drain = True
+                return
+        self.close()
 
     def _retry_read_on_writable(self) -> None:
         """EPOLLOUT follow-up for a TLS read that wanted a write."""

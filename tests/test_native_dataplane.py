@@ -360,3 +360,48 @@ class TestTunnelStress:
         finally:
             server.stop()
             server.join()
+
+
+class TestNativeFailover:
+    def test_lb_retry_steers_around_dead_native_server(self):
+        """Two native servers behind an rr LB; one dies under continuous
+        load — retries + feedback keep every call succeeding on the
+        survivor (reference failure-detection story on the native lane)."""
+
+        class NamedEcho(Service):
+            DESCRIPTOR = ECHO
+
+            def __init__(self, name):
+                super().__init__()
+                self.name = name
+
+            def Echo(self, cntl, request, done):
+                return echo_pb2.EchoResponse(message=self.name)
+
+        servers = []
+        for name in ("a", "b"):
+            s = Server(ServerOptions(native_dataplane=True))
+            s.add_service(NamedEcho(name))
+            s.start("127.0.0.1:0")
+            servers.append(s)
+        try:
+            url = ",".join(str(s.listen_endpoint()) for s in servers)
+            ch = Channel(ChannelOptions(timeout_ms=3000, max_retry=3,
+                                        native_transport=True))
+            ch.init(f"list://{url}", "rr")
+            stub = Stub(ch, ECHO)
+            seen = set()
+            for _ in range(10):
+                seen.add(stub.Echo(echo_pb2.EchoRequest(message="x")).message)
+            assert seen == {"a", "b"}
+            servers[0].stop()
+            servers[0].join()
+            after = set()
+            for _ in range(20):
+                after.add(stub.Echo(
+                    echo_pb2.EchoRequest(message="x")).message)
+            assert after == {"b"}, after  # every call succeeded via retry
+        finally:
+            for s in servers:
+                s.stop()
+                s.join(timeout=2)
