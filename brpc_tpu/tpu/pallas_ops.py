@@ -180,3 +180,89 @@ def flash_attention_mha(q, k, v, causal: bool = False, **kw):
     """(B, H, S, D) multi-head wrapper: vmapped flash_attention."""
     f = functools.partial(flash_attention, causal=causal, **kw)
     return jax.vmap(jax.vmap(f))(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Fused softmax cross-entropy — the other canonical memory-bound fusion:
+# per row, one VMEM pass computes max / logsumexp / target logit without
+# materializing the [rows, V] log-softmax in HBM.
+# ---------------------------------------------------------------------------
+def softmax_xent_reference(logits, targets):
+    """Mean negative log-likelihood; logits [N, V], targets [N] int."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[:, None], axis=-1)[:, 0]
+    return -jnp.mean(ll)
+
+
+def _xent_kernel(logits_ref, targets_ref, o_ref):
+    x = logits_ref[:].astype(jnp.float32)          # [bn, V]
+    t = targets_ref[:]                             # [bn, 1]
+    m = jnp.max(x, axis=-1, keepdims=True)
+    lse = m + jnp.log(jnp.sum(jnp.exp(x - m), axis=-1, keepdims=True))
+    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    picked = jnp.sum(jnp.where(cols == t, x, 0.0), axis=-1, keepdims=True)
+    o_ref[:] = lse - picked                        # per-row NLL
+
+
+def _xent_forward_rows(logits, targets, block_rows: int, interpret: bool):
+    """Per-row NLL via the fused kernel; rows padded to the block size and
+    masked out of the caller's mean (tiny-divisor row counts must not
+    degrade into a 1-row grid)."""
+    import jax.experimental.pallas as pl
+
+    n, v = logits.shape
+    bn = min(block_rows, max(n, 1))
+    n2 = ((n + bn - 1) // bn) * bn
+    if n2 != n:
+        logits = jnp.pad(logits, ((0, n2 - n), (0, 0)))
+        targets = jnp.pad(targets, (0, n2 - n))
+    nll = pl.pallas_call(
+        _xent_kernel,
+        grid=(n2 // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, v), lambda i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n2, 1), jnp.float32),
+        interpret=interpret,
+    )(logits, targets.astype(jnp.int32)[:, None])
+    return nll[:n, 0]
+
+
+@jax.custom_vjp
+def _softmax_xent_custom(logits, targets):
+    return jnp.mean(_xent_forward_rows(logits, targets, 256, not _on_tpu()))
+
+
+def _softmax_xent_fwd(logits, targets):
+    return _softmax_xent_custom(logits, targets), (logits, targets)
+
+
+def _softmax_xent_bwd(res, g):
+    # d(mean NLL)/dlogits = (softmax - onehot) / N; the backward stays a
+    # plain XLA softmax (already fused well) — the kernel wins the forward
+    logits, targets = res
+    n = logits.shape[0]
+    p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    onehot = jax.nn.one_hot(targets, logits.shape[1], dtype=jnp.float32)
+    return ((g * (p - onehot) / n).astype(logits.dtype), None)
+
+
+_softmax_xent_custom.defvjp(_softmax_xent_fwd, _softmax_xent_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def softmax_xent(logits, targets, block_rows: int = 256,
+                 interpret: bool = None):
+    """Fused mean cross-entropy; logits [N, V], targets [N] int.
+    Differentiable (custom VJP) so it drops into training losses."""
+    n = logits.shape[0]
+    if n == 0:
+        return jnp.float32(0.0)
+    if block_rows == 256 and interpret is None:
+        return _softmax_xent_custom(logits, targets)
+    if interpret is None:
+        interpret = not _on_tpu()
+    return jnp.mean(_xent_forward_rows(logits, targets, block_rows,
+                                       interpret))

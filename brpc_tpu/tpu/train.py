@@ -44,6 +44,7 @@ class ModelConfig:
     use_pallas_norm: bool = False  # flip on for TPU runs
     use_flash_attention: bool = False  # Pallas flash kernel (single-device
     #                                    path; needs S % 128 == 0)
+    use_fused_xent: bool = False       # Pallas fused cross-entropy loss
 
     @property
     def head_dim(self) -> int:
@@ -154,6 +155,11 @@ def forward(params, tokens, cfg: ModelConfig, mesh: Mesh = None,
 def loss_fn(params, batch, cfg: ModelConfig, mesh: Mesh = None):
     tokens, targets = batch
     logits = forward(params, tokens, cfg, mesh).astype(jnp.float32)
+    if cfg.use_fused_xent and mesh is None:
+        from brpc_tpu.tpu.pallas_ops import softmax_xent
+
+        B, S, V = logits.shape
+        return softmax_xent(logits.reshape(B * S, V), targets.reshape(-1))
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
