@@ -3,6 +3,8 @@ test/endpoint_unittest.cpp, test/resource_pool_unittest.cpp)."""
 
 import threading
 
+import random
+
 import pytest
 
 from brpc_tpu.butil import (
@@ -260,3 +262,77 @@ class TestCrc32c:
 
     def test_chaining_differs_by_input(self):
         assert crc32c(b"abc") != crc32c(b"abd")
+
+
+class TestIOBufModel:
+    def test_random_ops_match_bytes_model(self):
+        """Model-based check: a long random sequence of append/cutn/
+        pop_front/fetch/tobytes must agree with a plain bytes model
+        (the RTMP fuzz campaign corrupted IOBuf once via negative n —
+        this guards the whole op surface)."""
+        rng = random.Random(0xB0F)
+        buf = IOBuf()
+        model = b""
+        for step in range(3000):
+            op = rng.randrange(6)
+            if op in (0, 1):  # append (bytes or another IOBuf)
+                data = bytes(rng.randrange(256)
+                             for _ in range(rng.randrange(0, 64)))
+                if op == 0:
+                    buf.append(data)
+                else:
+                    other = IOBuf(data)
+                    buf.append(other)
+                    assert len(other) == 0  # refs stolen
+                model += data
+            elif op == 2 and model:  # cutn
+                n = rng.randrange(0, len(model) + 1)
+                cut = buf.cutn(n)
+                assert cut.tobytes() == model[:n], f"step {step}"
+                model = model[n:]
+            elif op == 3 and model:  # pop_front
+                n = rng.randrange(0, len(model) + 1)
+                buf.pop_front(n)
+                model = model[n:]
+            elif op == 4:  # fetch (peek, non-consuming)
+                n = rng.randrange(0, len(model) + 2)
+                assert buf.fetch(n) == model[:n], f"step {step}"
+            else:  # full compare
+                assert len(buf) == len(model), f"step {step}"
+                assert buf.tobytes() == model, f"step {step}"
+        assert buf.tobytes() == model
+
+    def test_negative_ops_rejected(self):
+        buf = IOBuf(b"abc")
+        with pytest.raises(ValueError):
+            buf.cutn(-1)
+        with pytest.raises(ValueError):
+            buf.pop_front(-2)
+        assert buf.tobytes() == b"abc"  # invariants intact after rejection
+
+
+class TestVersionedPoolModel:
+    def test_random_insert_remove_never_resolves_stale(self):
+        """A removed id must NEVER resolve again, even after its slot is
+        recycled (the reference's versioned SocketId contract,
+        versioned_ref_with_id.h:54)."""
+        rng = random.Random(0x5EED)
+        pool = VersionedPool()
+        live = {}    # id -> object
+        dead = []    # ids that must stay dead
+        for step in range(4000):
+            if live and rng.random() < 0.45:
+                vid = rng.choice(list(live))
+                pool.remove(vid)
+                del live[vid]
+                dead.append(vid)
+            else:
+                obj = object()
+                live[pool.insert(obj)] = obj
+            if dead and rng.random() < 0.3:
+                assert pool.address(rng.choice(dead)) is None, f"step {step}"
+            if live and rng.random() < 0.3:
+                vid = rng.choice(list(live))
+                assert pool.address(vid) is live[vid], f"step {step}"
+        for vid in dead[-200:]:
+            assert pool.address(vid) is None
