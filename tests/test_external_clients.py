@@ -132,9 +132,8 @@ class TestLibrtmpConformance:
         import time
 
         from brpc_tpu.policy.rtmp import MSG_VIDEO, RtmpClient, RtmpService
-        from brpc_tpu.rpc import Server as _Server
 
-        server = _Server(ServerOptions(rtmp_service=RtmpService()))
+        server = Server(ServerOptions(rtmp_service=RtmpService()))
         server.start("127.0.0.1:0")
         ep = server.listen_endpoint()
         pub = RtmpClient(ep.host, ep.port, app="live")
