@@ -424,8 +424,14 @@ void push_event(Runtime* rt, DpEvent ev) {
     usleep(1000);
     lk.lock();
   }
+  bool was_empty = rt->events.empty();
   rt->events.push_back(ev);
-  rt->ecv.notify_one();
+  if (was_empty) {
+    // consumers only sleep when the queue is empty (predicate-gated
+    // wait), so the 0->1 transition is the only one that needs a signal —
+    // per-message notifies were a futex syscall per frame under load
+    rt->ecv.notify_one();
+  }
 }
 
 void emit_failed(Runtime* rt, Conn* c, int err_class, const char* reason) {

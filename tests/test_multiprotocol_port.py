@@ -1,5 +1,5 @@
 """Single-port multiprotocol soak: one Server simultaneously serving
-trpc_std RPC, HTTP/1.1 JSON RPC, h2 dashboard, redis, mongo, and RTMP
+trpc_std RPC, HTTP/1.1 JSON RPC, gRPC (h2), redis, mongo, and RTMP
 from concurrent clients — the reference's single-port story under
 cross-protocol concurrency."""
 
