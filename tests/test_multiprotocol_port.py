@@ -1,5 +1,6 @@
 """Single-port multiprotocol soak: one Server simultaneously serving
-trpc_std RPC, HTTP/1.1 JSON RPC, gRPC (h2), redis, mongo, and RTMP
+trpc_std RPC, HTTP/1.1 JSON RPC, gRPC (h2), the h2 dashboard, redis,
+mongo, and RTMP
 from concurrent clients — the reference's single-port story under
 cross-protocol concurrency."""
 
@@ -117,6 +118,31 @@ def test_six_protocols_concurrently(kitchen_sink_server):
                                   MongoRequest({"ping": 1})).ok
 
     @guard
+    def h2_dashboard_client():
+        # plain HTTP/2 (no grpc content-type) hits the builtin dashboard
+        import socket as _socket
+
+        from brpc_tpu.policy.h2 import PREFACE, pack_frame, pack_settings
+        from brpc_tpu.policy.hpack import HpackEncoder
+
+        for _ in range(max(3, rounds // 5)):
+            enc = HpackEncoder()
+            hdrs = enc.encode([(":method", "GET"), (":scheme", "http"),
+                               (":path", "/health"), (":authority", "t")])
+            with _socket.create_connection((ep.host, ep.port),
+                                           timeout=5) as s:
+                s.sendall(PREFACE + pack_settings([]) +
+                          pack_frame(1, 0x4 | 0x1, 1, hdrs))
+                s.settimeout(5)
+                data = b""
+                while b"OK" not in data:
+                    chunk = s.recv(4096)
+                    if not chunk:
+                        break
+                    data += chunk
+                assert b"OK" in data
+
+    @guard
     def rtmp_pair():
         pub = RtmpClient(ep.host, ep.port)
         sub = RtmpClient(ep.host, ep.port)
@@ -146,7 +172,7 @@ def test_six_protocols_concurrently(kitchen_sink_server):
 
     threads = [threading.Thread(target=fn) for fn in
                (trpc_client, http_client, grpc_client, redis_client,
-                mongo_client, rtmp_pair)]
+                mongo_client, h2_dashboard_client, rtmp_pair)]
     for t in threads:
         t.start()
     for t in threads:

@@ -405,3 +405,35 @@ class TestNativeFailover:
             for s in servers:
                 s.stop()
                 s.join(timeout=2)
+
+
+class TestTunnelGarbageResilience:
+    def test_garbage_on_tpu_listener_kills_only_that_conn(self):
+        """Raw TCP garbage at a native tpu listener must fail that conn
+        alone; real tunnel clients keep working."""
+        import socket as _socket
+
+        server = Server(ServerOptions(native_dataplane=True))
+        server.add_service(EchoImpl())
+        server.start("tpu://127.0.0.1:0/0")
+        try:
+            ep = server.listen_endpoint()
+            stub = _stub(server, native=True, timeout_ms=10000)
+            stub.Echo(echo_pb2.EchoRequest(message="before"))
+            for payload in (b"TPUC" + b"\xff" * 64,        # bad frame
+                            b"TPUC\x03" + b"\x7f\xff\xff\xff",  # huge len
+                            b"\x00" * 32):                 # not TPUC at all
+                with _socket.create_connection((ep.host, ep.port),
+                                               timeout=5) as s:
+                    s.sendall(payload)
+                    s.settimeout(2)
+                    try:
+                        while s.recv(4096):
+                            pass
+                    except (TimeoutError, OSError):
+                        pass
+            r = stub.Echo(echo_pb2.EchoRequest(message="after"))
+            assert r.message == "after"  # the real tunnel survived
+        finally:
+            server.stop()
+            server.join()
