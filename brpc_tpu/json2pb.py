@@ -138,7 +138,13 @@ def _message_to_dict(msg, opts: Pb2JsonOptions) -> dict:
             if msg.HasField(name):
                 out[name] = _message_to_dict(getattr(msg, name), opts)
             continue
-        if field.containing_oneof is not None:
+        if field.containing_oneof is not None \
+                or getattr(field, "has_presence", False):
+            # explicit presence (oneof member, proto3 `optional` via its
+            # synthetic oneof, proto2 optional scalar): emission follows
+            # the has-bit, so a field explicitly set to its default
+            # survives the round trip (reference pb_to_json.cpp checks
+            # has-bits, not values)
             if msg.HasField(name):
                 out[name] = _value_to_json(field, getattr(msg, name), opts)
             continue

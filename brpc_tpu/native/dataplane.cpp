@@ -410,7 +410,43 @@ struct Runtime {
   };
   std::mutex rmu;  // native service registry
   std::vector<EchoSvc> echo_services;
+
+  // TPUC per-conn sender workers: tracked (not detached) so shutdown can
+  // quiesce them before the Runtime dies. Finished entries are reaped on
+  // the next registration (one worker per conn lifetime keeps this small).
+  struct SenderSlot {
+    std::thread thr;
+    std::shared_ptr<std::atomic<bool>> done;
+  };
+  std::mutex smu_senders;
+  std::vector<SenderSlot> senders;
+
+  // listeners muted after EMFILE/ENFILE (fd exhaustion): disarmed from
+  // epoll so level-triggered readiness cannot busy-spin loop 0, re-armed
+  // by the loop tick once the backoff expires
+  std::mutex amu;
+  std::vector<std::pair<int, int64_t>> muted_listeners;  // (lid, rearm_ns)
 };
+
+int64_t mono_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return int64_t(ts.tv_sec) * 1000000000 + ts.tv_nsec;
+}
+
+void register_sender(Runtime* rt, std::thread thr,
+                     std::shared_ptr<std::atomic<bool>> done) {
+  std::lock_guard<std::mutex> lk(rt->smu_senders);
+  for (auto it = rt->senders.begin(); it != rt->senders.end();) {
+    if (it->done->load()) {
+      it->thr.join();
+      it = rt->senders.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  rt->senders.push_back({std::move(thr), std::move(done)});
+}
 
 // ------------------------------------------------------------------ helpers
 void push_event(Runtime* rt, DpEvent ev) {
@@ -832,7 +868,8 @@ bool try_native_echo(Runtime* rt, const std::shared_ptr<Conn>& c,
       t->respq.push_back(std::move(resp));
       if (!t->sender_running) {
         t->sender_running = true;
-        std::thread([rt, c] {
+        auto done = std::make_shared<std::atomic<bool>>(false);
+        std::thread thr([rt, c, done] {
           TpuState* ts = c->tpu.get();
           for (;;) {
             TpuState::Resp item;
@@ -842,7 +879,10 @@ bool try_native_echo(Runtime* rt, const std::shared_ptr<Conn>& c,
                 return !ts->respq.empty() || ts->q_closed ||
                        c->failed.load();
               });
-              if (ts->respq.empty()) return;  // closed/failed: drain done
+              if (ts->respq.empty()) {  // closed/failed: drain done
+                done->store(true);
+                return;
+              }
               item = std::move(ts->respq.front());
               ts->respq.pop_front();
             }
@@ -853,14 +893,18 @@ bool try_native_echo(Runtime* rt, const std::shared_ptr<Conn>& c,
             int rc = tpu_send_packet(rt, c, bb, ll, 2);
             free(item.base);
             if (rc != DPE_OK) {
-              loop_submit(rt, c->loop, [rt, c] {
-                conn_fail(rt, c, DPE_IO,
-                          "native service response undeliverable");
-              });
+              if (rt->running.load()) {
+                loop_submit(rt, c->loop, [rt, c] {
+                  conn_fail(rt, c, DPE_IO,
+                            "native service response undeliverable");
+                });
+              }
+              done->store(true);
               return;
             }
           }
-        }).detach();
+        });
+        register_sender(rt, std::move(thr), done);
       }
     }
     t->qcv.notify_one();
@@ -1471,6 +1515,15 @@ void accept_ready(Runtime* rt, int lid) {
                      SOCK_NONBLOCK | SOCK_CLOEXEC);
     if (fd < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) return;
+      if (errno == EMFILE || errno == ENFILE || errno == ENOBUFS ||
+          errno == ENOMEM) {
+        // fd exhaustion: the listener stays readable forever under
+        // level-triggered epoll, which would turn loop 0 into a 100% spin.
+        // Disarm it and let the loop tick re-arm after a backoff.
+        epoll_ctl(rt->loops[0]->epfd, EPOLL_CTL_DEL, lfd, nullptr);
+        std::lock_guard<std::mutex> lk(rt->amu);
+        rt->muted_listeners.emplace_back(lid, mono_ns() + 100000000);
+      }
       return;
     }
     int one = 1;
@@ -1512,6 +1565,42 @@ void loop_run(Runtime* rt, int li) {
     if (n < 0) {
       if (errno == EINTR) continue;
       break;
+    }
+    if (li == 0) {
+      // re-arm listeners muted by fd exhaustion once their backoff expires
+      std::lock_guard<std::mutex> alk(rt->amu);
+      if (!rt->muted_listeners.empty()) {
+        int64_t now = mono_ns();
+        for (auto it = rt->muted_listeners.begin();
+             it != rt->muted_listeners.end();) {
+          if (now < it->second) {
+            ++it;
+            continue;
+          }
+          int lfd = -1;
+          {
+            std::lock_guard<std::mutex> clk(rt->cmu);
+            if (it->first >= 0 &&
+                size_t(it->first) < rt->listeners.size()) {
+              lfd = rt->listeners[size_t(it->first)].fd;
+            }
+          }
+          if (lfd >= 0) {
+            epoll_event ev{};
+            ev.events = EPOLLIN;
+            ev.data.u64 = kListenerBit | uint64_t(it->first);
+            if (epoll_ctl(l->epfd, EPOLL_CTL_ADD, lfd, &ev) != 0 &&
+                errno != EEXIST) {
+              // still under resource pressure: keep retrying, never leave
+              // the listener in neither epoll nor the retry list
+              it->second = now + 100000000;
+              ++it;
+              continue;
+            }
+          }
+          it = rt->muted_listeners.erase(it);
+        }
+      }
     }
     for (int i = 0; i < n; i++) {
       uint64_t key = evs[i].data.u64;
@@ -1588,16 +1677,36 @@ void dp_rt_shutdown(void* h) {
   for (auto& l : rt->loops) {
     if (l->thr.joinable()) l->thr.join();
   }
+  // Quiesce every conn BEFORE tearing the Runtime down: mark failed and
+  // retire the fd under wmu (so an in-flight writer can't land on a
+  // recycled fd), then wake the TPUC machinery so blocked sender workers
+  // observe closed/q_closed and exit.
+  std::vector<std::shared_ptr<Conn>> conns;
   {
     std::lock_guard<std::mutex> lk(rt->cmu);
-    for (auto& kv : rt->conns) {
-      if (kv.second->fd >= 0) close(kv.second->fd);
-    }
+    for (auto& kv : rt->conns) conns.push_back(kv.second);
     rt->conns.clear();
     for (auto& l : rt->listeners) {
       if (l.fd >= 0) close(l.fd);
     }
   }
+  for (auto& c : conns) {
+    c->failed.store(true);
+    {
+      std::lock_guard<std::mutex> wlk(c->wmu);
+      if (c->fd >= 0) close(c->fd);
+      c->fd = -1;
+    }
+    tpu_teardown(c.get());
+  }
+  {
+    std::lock_guard<std::mutex> lk(rt->smu_senders);
+    for (auto& s : rt->senders) {
+      if (s.thr.joinable()) s.thr.join();
+    }
+    rt->senders.clear();
+  }
+  conns.clear();
   {
     std::lock_guard<std::mutex> lk(rt->emu);
     for (auto& ev : rt->events) free(ev.base);
@@ -1993,7 +2102,8 @@ int dp_bench_echo2(const char* host, int port, int use_tpu, int nconns,
       if (ev.kind == EV_FRAME) {
         MetaLite m;
         const uint8_t* mp = static_cast<const uint8_t*>(ev.meta);
-        if (parse_meta_lite(mp, mp + ev.meta_len, &m) && m.correlation_id) {
+        if (parse_meta_lite(mp, mp + ev.meta_len, &m) && m.correlation_id &&
+            m.correlation_id <= uint64_t(nconns) * uint64_t(depth)) {
           uint64_t cid = m.correlation_id;
           int64_t t0 = sent_ns[cid - 1].load(std::memory_order_relaxed);
           {

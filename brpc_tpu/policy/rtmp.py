@@ -221,9 +221,42 @@ class RtmpService:
                 s = self._streams[name] = RtmpStream(name)
             return s
 
+    # Attach under the registry lock: a lookup followed by a later attach
+    # could otherwise interleave with release_if_idle deleting the entry,
+    # leaving the publisher/viewer on an orphaned stream object forever.
+    def attach_publisher(self, name: str, conn) -> RtmpStream:
+        with self._lock:
+            s = self._streams.get(name)
+            if s is None:
+                s = self._streams[name] = RtmpStream(name)
+            with s.lock:
+                s.publisher = conn
+            return s
+
+    def attach_subscriber(self, name: str, conn,
+                          stream_id: int) -> Tuple[RtmpStream, bytes]:
+        with self._lock:
+            s = self._streams.get(name)
+            if s is None:
+                s = self._streams[name] = RtmpStream(name)
+            with s.lock:
+                s.subscribers.append((conn, stream_id))
+                meta = s.metadata
+            return s, meta
+
     def stream_names(self) -> List[str]:
         with self._lock:
             return sorted(self._streams)
+
+    def release_if_idle(self, stream: "RtmpStream") -> None:
+        """Drop a registry entry once nobody publishes or plays it — an
+        untrusted publisher cycling fresh names must not grow the registry
+        for the server's lifetime."""
+        with self._lock:
+            with stream.lock:
+                idle = stream.publisher is None and not stream.subscribers
+            if idle and self._streams.get(stream.name) is stream:
+                del self._streams[stream.name]
 
 
 # ------------------------------------------------------- server connection
@@ -296,9 +329,7 @@ class _RtmpConn:
         elif cmd == "publish":
             name = vals[3] if len(vals) > 3 and isinstance(vals[3], str) \
                 else ""
-            stream = self.service.stream(name)
-            with stream.lock:
-                stream.publisher = self
+            stream = self.service.attach_publisher(name, self)
             self.publishing = stream
             self.send_command(
                 stream_id, "onStatus", 0.0, None,
@@ -307,16 +338,14 @@ class _RtmpConn:
         elif cmd == "play":
             name = vals[3] if len(vals) > 3 and isinstance(vals[3], str) \
                 else ""
-            stream = self.service.stream(name)
+            stream, meta = self.service.attach_subscriber(name, self,
+                                                          stream_id)
             self.send_msg(2, MSG_USER_CONTROL, 0,
                           struct.pack(">HI", UC_STREAM_BEGIN, stream_id))
             self.send_command(
                 stream_id, "onStatus", 0.0, None,
                 {"level": "status", "code": "NetStream.Play.Start",
                  "description": f"Started playing {name}."})
-            with stream.lock:
-                stream.subscribers.append((self, stream_id))
-                meta = stream.metadata
             if meta:  # late joiners still get the stream metadata
                 self.send_msg(5, MSG_DATA_AMF0, stream_id, meta)
             self.playing.append(stream)
@@ -340,16 +369,21 @@ class _RtmpConn:
                 pass
 
     def teardown(self) -> None:
+        released = []
         if self.publishing is not None:
             with self.publishing.lock:
                 if self.publishing.publisher is self:
                     self.publishing.publisher = None
+            released.append(self.publishing)
             self.publishing = None
         for stream in self.playing:
             with stream.lock:
                 stream.subscribers = [(c, s) for c, s in stream.subscribers
                                       if c is not self]
+            released.append(stream)
         self.playing = []
+        for stream in released:
+            self.service.release_if_idle(stream)
 
 
 class RtmpProtocol(Protocol):

@@ -125,3 +125,39 @@ class TestJsonToPbOptions:
 
     def test_empty_body_default_message(self):
         assert json_to_pb("", tp.JsonScratch) == tp.JsonScratch()
+
+
+class TestExplicitPresence:
+    """Explicit-presence scalars follow the has-bit, not the value
+    (ADVICE r2; reference pb_to_json.cpp checks has-bits)."""
+
+    def test_proto2_optional_set_to_default_is_emitted(self):
+        from brpc_tpu.proto import jsonpb_test2_pb2 as tp2
+        m = tp2.Proto2Scratch(must=5)
+        m.opt_i32 = 0
+        d = json.loads(pb_to_json(m))
+        assert d["opt_i32"] == 0 and "opt_text" not in d
+
+    def test_proto2_roundtrip_preserves_presence(self):
+        from brpc_tpu.proto import jsonpb_test2_pb2 as tp2
+        m = tp2.Proto2Scratch(must=5)
+        m.opt_i32 = 0
+        back = json_to_pb(pb_to_json(m), tp2.Proto2Scratch)
+        assert back.HasField("opt_i32")
+        assert not back.HasField("opt_text")
+
+    def test_set_to_default_is_emitted(self):
+        m = tp.JsonScratch()
+        m.maybe_i32 = 0
+        assert json.loads(pb_to_json(m))["maybe_i32"] == 0
+
+    def test_unset_is_omitted(self):
+        assert "maybe_i32" not in json.loads(pb_to_json(tp.JsonScratch()))
+
+    def test_roundtrip_preserves_presence(self):
+        m = tp.JsonScratch()
+        m.maybe_i32 = 0
+        back = json_to_pb(pb_to_json(m), tp.JsonScratch)
+        assert back.HasField("maybe_i32")
+        back2 = json_to_pb(pb_to_json(tp.JsonScratch()), tp.JsonScratch)
+        assert not back2.HasField("maybe_i32")

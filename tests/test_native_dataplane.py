@@ -7,6 +7,7 @@ pairing, the C++ native-service fast path, the DETACH fallback for
 non-TRPC protocols on a native port, and failure fanout.
 """
 
+import ctypes
 import socket as _socket
 import threading
 import time
@@ -437,3 +438,49 @@ class TestTunnelGarbageResilience:
         finally:
             server.stop()
             server.join()
+
+
+class TestShutdownQuiesce:
+    """dp_rt_shutdown must quiesce TPUC sender workers mid-traffic
+    (ADVICE r2 medium: detached senders leaked threads/conns/shm and could
+    UAF the Runtime at shutdown)."""
+
+    def test_shutdown_under_tunnel_load_returns_promptly(self):
+        from brpc_tpu import native
+
+        lib = native.load_dataplane()
+        if lib is None:
+            pytest.skip("native engine unavailable")
+        rt = lib.dp_rt_create(2, 0)
+        lid = lib.dp_listen(rt, b"127.0.0.1", 0)
+        assert lid >= 0
+        lib.dp_listener_set_tpu(rt, lid, 0)
+        lib.dp_register_echo(rt, lid, b"EchoService", b"Echo")
+        port = lib.dp_listen_port(rt, lid)
+
+        # drive large echoes through the tunnel from a separate bench
+        # runtime so per-conn sender workers are live when we shut down
+        result = {}
+
+        def bench():
+            outs = [ctypes.c_double() for _ in range(5)]
+            result["rc"] = lib.dp_bench_echo2(
+                b"127.0.0.1", port, 1, 2, 4, 1 << 20, 8000,
+                b"EchoService", b"Echo",
+                *[ctypes.byref(o) for o in outs])
+
+        t = threading.Thread(target=bench, daemon=True)
+        t.start()
+        time.sleep(1.0)  # let traffic flow
+
+        done = threading.Event()
+
+        def shut():
+            lib.dp_rt_shutdown(rt)
+            done.set()
+
+        s = threading.Thread(target=shut, daemon=True)
+        s.start()
+        assert done.wait(15), "dp_rt_shutdown hung (sender quiesce broken)"
+        t.join(timeout=20)
+        assert not t.is_alive()

@@ -203,3 +203,39 @@ class TestExtendedTimestamp:
         finally:
             pub.close()
             sub.close()
+
+
+class TestRegistryGc:
+    def test_idle_streams_are_released(self, rtmp_server):
+        """A publisher cycling fresh names must not grow the registry
+        forever (ADVICE r2: unbounded _streams)."""
+        server, service = rtmp_server
+        ep = server.listen_endpoint()
+        pub = RtmpClient(ep.host, ep.port, app="live")
+        try:
+            sid = pub.create_stream()
+            pub.publish("scan-a", sid)
+        finally:
+            pub.close()
+        deadline = time.time() + 5
+        while service.stream_names() and time.time() < deadline:
+            time.sleep(0.05)
+        assert service.stream_names() == []
+
+    def test_stream_with_subscriber_survives(self, rtmp_server):
+        server, service = rtmp_server
+        ep = server.listen_endpoint()
+        sub = RtmpClient(ep.host, ep.port, app="live")
+        pub = RtmpClient(ep.host, ep.port, app="live")
+        try:
+            sub.play("held", sub.create_stream())
+            pub.publish("held", pub.create_stream())
+            pub.close()  # publisher leaves; viewer still holds the stream
+            deadline = time.time() + 2
+            while "held" in service.stream_names() \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            assert "held" in service.stream_names()
+        finally:
+            pub.close()
+            sub.close()
