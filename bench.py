@@ -252,16 +252,52 @@ def bench_native_tpu_lane():
         srv.close()
 
 
+def _run_pipelined(stub, echo_pb2, payload: bytes, depth: int, total: int):
+    """Async pipelined echoes (done callbacks re-issue): the client poller
+    drives completions, no per-call thread wake — the shape the reference's
+    own QPS benchmarks use (pipelined clients, depth > 1)."""
+    done_ev = threading.Event()
+    state = {"issued": 0, "completed": 0, "errors": 0}
+    lats = []
+    req = echo_pb2.EchoRequest(message="b", payload=payload)
+
+    def make_done(t0):
+        def done(cntl):
+            lats.append(time.perf_counter() - t0)
+            if cntl.failed():
+                state["errors"] += 1
+            state["completed"] += 1
+            if state["issued"] < total:
+                state["issued"] += 1
+                stub.Echo(req, done=make_done(time.perf_counter()))
+            elif state["completed"] >= total:
+                done_ev.set()
+        return done
+
+    t_start = time.perf_counter()
+    for _ in range(depth):
+        state["issued"] += 1
+        stub.Echo(req, done=make_done(time.perf_counter()))
+    if not done_ev.wait(120):
+        raise RuntimeError(
+            f"pipelined bench stalled: {state['completed']}/{total}")
+    wall = time.perf_counter() - t_start
+    if state["errors"]:
+        raise RuntimeError(f"{state['errors']} pipelined calls failed")
+    lats.sort()
+    return wall, lats
+
+
 def bench_hybrid_native():
     """Python client/service code over the native engine (the hybrid lane
-    most users run): QPS + 1MB attachment echo."""
+    most users run): sync-thread QPS, pipelined QPS, 1MB attachment echo."""
     from brpc_tpu.proto import echo_pb2
     from brpc_tpu.rpc import Channel, ChannelOptions, Controller, Stub
     from brpc_tpu.rpc.native_transport import dataplane_available
 
     if not dataplane_available():
         return
-    srv = _BenchServer("127.0.0.1:0", "--native")
+    srv = _BenchServer("127.0.0.1:0", "--native", "--inline")
     try:
         ch = Channel(ChannelOptions(protocol="trpc_std", timeout_ms=30000,
                                     native_transport=True))
@@ -272,6 +308,19 @@ def bench_hybrid_native():
         wall, lats = _run_calls(stub, echo_pb2, b"x" * 16, QPS_THREADS, calls)
         print(f"# hybrid lane (py client+service, native engine): "
               f"qps={len(lats)/wall:,.0f} "
+              f"p50={_percentile(lats,0.5)*1e6:.0f}us "
+              f"p99={_percentile(lats,0.99)*1e6:.0f}us", file=sys.stderr)
+        # pipelined async client against the same full-policy Python service
+        chp = Channel(ChannelOptions(protocol="trpc_std", timeout_ms=30000,
+                                     native_transport=True,
+                                     done_inline=True))
+        chp.init(srv.endpoint)
+        stubp = Stub(chp, echo_pb2.DESCRIPTOR.services_by_name["EchoService"])
+        _run_pipelined(stubp, echo_pb2, b"w" * 16, 8, 200)  # warmup
+        total = 2000 if QUICK else 40000
+        wall, lats = _run_pipelined(stubp, echo_pb2, b"x" * 16, 32, total)
+        print(f"# hybrid lane pipelined (depth=32, done_inline, "
+              f"usercode_inline): qps={len(lats)/wall:,.0f} "
               f"p50={_percentile(lats,0.5)*1e6:.0f}us "
               f"p99={_percentile(lats,0.99)*1e6:.0f}us", file=sys.stderr)
         # 1MB attachment echo, single thread (GIL makes threads moot here)

@@ -71,6 +71,16 @@ def status_service(server, http: HttpMessage):
                     f"max={lr.max_latency():.0f}us "
                     f"concurrency={entry.current_concurrency} "
                     f"errors={entry.errors_count.get_value()}")
+        native = server.native_method_stats() \
+            if hasattr(server, "native_method_stats") else []
+        for sname, mname, st in native:
+            out.append(
+                f"\n[{sname}] (native)\n"
+                f"  {mname}: count={st['requests']} "
+                f"latency={st['latency_avg_us']:.0f}us "
+                f"max={st['latency_max_us']:.0f}us "
+                f"concurrency={st['concurrency']} "
+                f"errors={st['errors']}")
     return 200, CONTENT_TEXT, "\n".join(out) + "\n"
 
 

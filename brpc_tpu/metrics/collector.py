@@ -39,6 +39,11 @@ class Collector:
         self._lock = threading.Lock()
         self._tokens = None  # primed to a full bucket on first ask
         self._last_refill = time.monotonic()
+        # monotonic instant before which asks are denied without taking the
+        # lock — under sustained sampling pressure (every RPC asks) nearly
+        # all asks hit this branch (GIL-atomic read; small approximation
+        # races only ever deny a touch early)
+        self._deny_until = 0.0
         self.grants = Adder()
         self.denies = Adder()
         self.grants.expose_as("collector_grants")
@@ -56,6 +61,9 @@ class Collector:
             self.grants.put(weight)
             return True  # cap disabled
         now = time.monotonic()
+        if now < self._deny_until:
+            self.denies.put(weight)
+            return False
         with self._lock:
             if self._tokens is None:
                 self._tokens = float(rate)  # full bucket at startup
@@ -69,6 +77,9 @@ class Collector:
                 granted = True
             else:
                 granted = False
+                # bucket refills at `rate`/s: deny lock-free until the
+                # missing fraction of a token has accrued
+                self._deny_until = now + (weight - self._tokens) / rate
         (self.grants if granted else self.denies).put(weight)
         return granted
 
@@ -79,6 +90,9 @@ _collector_lock = threading.Lock()
 
 def global_collector() -> Collector:
     global _collector
+    c = _collector  # GIL-atomic read: no lock once initialized (hot path)
+    if c is not None:
+        return c
     with _collector_lock:
         if _collector is None:
             _collector = Collector()

@@ -34,10 +34,13 @@ class IntRecorder(Reducer):
 
 class LatencyRecorder:
     def __init__(self, window_size: int = 10, collector=None):
+        import threading as _threading
+
         self._recorder = IntRecorder()
         self._percentile = Percentile()
         self._maxer = Maxer()
         self._count = Adder()
+        self._fused_tls = _threading.local()
         self.window_size = window_size
         self._win_recorder = Window(self._recorder, window_size, collector)
         self._win_percentile = WindowedPercentile(
@@ -48,10 +51,23 @@ class LatencyRecorder:
 
     # ------------------------------------------------------------ write side
     def record(self, latency_us: float) -> "LatencyRecorder":
-        self._recorder.record(latency_us)
-        self._percentile.put(latency_us)
-        self._maxer.put(latency_us)
-        self._count.put(1)
+        # fused fast path: one TLS lookup, direct agent mutation (at 100k+
+        # records/s the four dispatch+lambda rounds of the naive version
+        # are measurable wall clock on the shared core); read side is the
+        # component reducers', untouched
+        tls = self._fused_tls
+        f = getattr(tls, "agents", None)
+        if f is None:
+            f = (self._recorder._agent(), self._percentile._reservoir(),
+                 self._maxer._agent(), self._count._agent())
+            tls.agents = f
+        ra, res, ma, ca = f
+        s, c = ra.value
+        ra.value = (s + latency_us, c + 1)
+        res.add(latency_us)
+        if latency_us > ma.value:
+            ma.value = latency_us
+        ca.value += 1
         return self
 
     __lshift__ = record

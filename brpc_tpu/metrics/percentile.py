@@ -104,7 +104,9 @@ class Percentile:
         # samples from dead threads, harvested into the next reset()
         self._retired = PercentileSamples()
 
-    def put(self, value: float) -> None:
+    def _reservoir(self) -> "_ThreadReservoir":
+        """This thread's reservoir, registered on first use (exposed so
+        LatencyRecorder's fused write path can cache it)."""
         res = getattr(self._tls, "res", None)
         if res is None:
             res = _ThreadReservoir()
@@ -114,7 +116,10 @@ class Percentile:
             with self._lock:
                 self._reservoirs.append(res)
             weakref.finalize(anchor, self._retire, res)
-        res.add(value)
+        return res
+
+    def put(self, value: float) -> None:
+        self._reservoir().add(value)
 
     __lshift__ = put
 

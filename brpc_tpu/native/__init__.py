@@ -178,7 +178,43 @@ def load_dataplane() -> Optional[ctypes.CDLL]:
             ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_char_p,
             ctypes.c_char_p,
         ] + [ctypes.POINTER(ctypes.c_double)] * 5
-        if lib.dp_abi_version() != 1:
+        # fast path (abi 2): engine-side meta parse/pack for Python RPCs
+        lib.dp_listener_set_fastpath.restype = ctypes.c_int
+        lib.dp_listener_set_fastpath.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_int, ctypes.c_int]
+        lib.dp_conn_set_fastpath.restype = ctypes.c_int
+        lib.dp_conn_set_fastpath.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_uint64, ctypes.c_int]
+        lib.dp_respond.restype = ctypes.c_int
+        lib.dp_respond.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int]
+        lib.dp_call.restype = ctypes.c_int
+        lib.dp_call.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_int]
+        lib.dp_flush_all.restype = ctypes.c_int
+        lib.dp_flush_all.argtypes = [ctypes.c_void_p]
+        lib.dp_svc_set_limit.restype = ctypes.c_int
+        lib.dp_svc_set_limit.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                         ctypes.c_char_p, ctypes.c_char_p,
+                                         ctypes.c_int]
+        lib.dp_listener_set_logoff.restype = ctypes.c_int
+        lib.dp_listener_set_logoff.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                               ctypes.c_int]
+        lib.dp_svc_stats.restype = ctypes.c_int
+        lib.dp_svc_stats.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int32)]
+        if lib.dp_abi_version() != 2:
             _dp_build_error = "dataplane abi mismatch"
             return None
         _dp_lib = lib

@@ -88,7 +88,35 @@ enum {
   EV_FAILED = 2,    // tag: error class; meta: reason text
   EV_ACCEPTED = 3,  // aux: listener id; meta: "host:port" of peer
   EV_DETACHED = 4,  // aux: fd (now owned by consumer); meta: buffered bytes
+  // fast-path events: the engine already parsed RpcMeta — Python never
+  // touches protobuf on these (reference keeps ProcessRpcRequest native,
+  // baidu_rpc_protocol.cpp:565; this is our analog for Python services)
+  EV_REQUEST = 5,   // aux: cid; meta: ReqLite+svc+method; body: payload+att
+  EV_RESPONSE = 6,  // aux: cid; tag: error_code; meta: RespLite+error_text
 };
+
+// packed structs riding EV_REQUEST / EV_RESPONSE meta buffers (same-machine
+// host endianness; Python reads them with struct.unpack_from)
+struct ReqLite {
+  uint64_t cid;
+  uint64_t attempt;
+  uint64_t att_size;
+  int64_t log_id;
+  int64_t trace_id;   // sampled traces ride the fast path end to end
+  int64_t span_id;
+  int32_t timeout_ms;
+  uint16_t svc_len;
+  uint16_t meth_len;
+};
+struct RespLite {
+  uint64_t attempt;
+  uint64_t att_size;
+};
+
+// frames at/above this take the zero-copy donation path (EV_FRAME with the
+// whole read buffer) instead of the parsed fast path — the pb meta parse is
+// noise at that size and the memcpy is not
+constexpr uint64_t kFastFrameMax = 64 << 10;
 
 // error classes for EV_FAILED.tag / dp_send return (Python maps to errors.py)
 enum {
@@ -174,6 +202,12 @@ struct MetaLite {
   uint64_t compress_type = 0;
   uint64_t attachment_size = 0;
   uint64_t checksum = 0;
+  int64_t log_id = 0;
+  int64_t trace_id = 0;
+  int64_t span_id = 0;
+  int64_t timeout_ms = 0;
+  int64_t resp_error_code = 0;
+  std::string resp_error_text;
   std::string service;
   std::string method;
 };
@@ -192,6 +226,42 @@ bool parse_request_meta(const uint8_t* p, const uint8_t* end, MetaLite* m) {
       uint64_t len;
       if (!pb_varint(p, end, &len) || uint64_t(end - p) < len) return false;
       m->method.assign(reinterpret_cast<const char*>(p), len);
+      p += len;
+    } else if (field == 3 && wt == 0) {
+      uint64_t v;
+      if (!pb_varint(p, end, &v)) return false;
+      m->log_id = int64_t(v);
+    } else if ((field == 4 || field == 5) && wt == 0) {
+      uint64_t v;
+      if (!pb_varint(p, end, &v)) return false;
+      // traces ride the fast path: ReqLite carries the ids end to end
+      if (field == 4) m->trace_id = int64_t(v);
+      else m->span_id = int64_t(v);
+    } else if (field == 7 && wt == 0) {
+      uint64_t v;
+      if (!pb_varint(p, end, &v)) return false;
+      m->timeout_ms = int64_t(v);
+    } else if (!pb_skip(p, end, wt)) {
+      return false;
+    }
+  }
+  return true;
+}
+
+bool parse_response_meta(const uint8_t* p, const uint8_t* end, MetaLite* m) {
+  while (p < end) {
+    uint64_t key;
+    if (!pb_varint(p, end, &key)) return false;
+    uint32_t field = key >> 3, wt = key & 7;
+    if (field == 1 && wt == 0) {
+      uint64_t v;
+      if (!pb_varint(p, end, &v)) return false;
+      // int32 on the wire: negatives arrive as 10-byte varints
+      m->resp_error_code = int64_t(int32_t(uint32_t(v)));
+    } else if (field == 2 && wt == 2) {
+      uint64_t len;
+      if (!pb_varint(p, end, &len) || uint64_t(end - p) < len) return false;
+      m->resp_error_text.assign(reinterpret_cast<const char*>(p), len);
       p += len;
     } else if (!pb_skip(p, end, wt)) {
       return false;
@@ -215,8 +285,11 @@ bool parse_meta_lite(const uint8_t* p, const uint8_t* end, MetaLite* m) {
         p += v;
         break;
       case 2:  // ResponseMeta
+        if (wt != 2) return false;
+        if (!pb_varint(p, end, &v) || uint64_t(end - p) < v) return false;
         m->has_response = true;
-        if (!pb_skip(p, end, wt)) return false;
+        if (!parse_response_meta(p, p + v, m)) return false;
+        p += v;
         break;
       case 3:
         if (!pb_varint(p, end, &m->correlation_id)) return false;
@@ -268,6 +341,104 @@ std::string build_echo_response_meta(const MetaLite& req) {
     pb_put_varint(&meta, req.attachment_size);
   }
   return meta;
+}
+
+// General response RpcMeta for dp_respond (server_processing._send_response
+// kept native): response{error_code,error_text}, cid, attempt, att_size.
+std::string build_response_meta(uint64_t cid, uint64_t attempt,
+                                int32_t error_code, const char* etext,
+                                uint64_t etext_len, uint64_t att_size,
+                                int32_t compress_type = 0) {
+  std::string resp;
+  if (error_code) {
+    pb_put_tag(&resp, 1, 0);
+    pb_put_varint(&resp, uint64_t(uint32_t(error_code)));
+  }
+  if (etext_len) {
+    pb_put_tag(&resp, 2, 2);
+    pb_put_varint(&resp, etext_len);
+    resp.append(etext, etext_len);
+  }
+  std::string meta;
+  pb_put_tag(&meta, 2, 2);
+  pb_put_varint(&meta, resp.size());
+  meta.append(resp);
+  if (cid) {
+    pb_put_tag(&meta, 3, 0);
+    pb_put_varint(&meta, cid);
+  }
+  if (attempt) {
+    pb_put_tag(&meta, 4, 0);
+    pb_put_varint(&meta, attempt);
+  }
+  if (compress_type) {
+    pb_put_tag(&meta, 5, 0);
+    pb_put_varint(&meta, uint64_t(uint32_t(compress_type)));
+  }
+  if (att_size) {
+    pb_put_tag(&meta, 6, 0);
+    pb_put_varint(&meta, att_size);
+  }
+  return meta;
+}
+
+// Request RpcMeta for dp_call (Controller._issue_rpc's meta kept native).
+std::string build_request_meta(const char* svc, uint64_t svc_len,
+                               const char* meth, uint64_t meth_len,
+                               uint64_t cid, uint64_t attempt,
+                               int64_t log_id, int64_t trace_id,
+                               int64_t span_id, int32_t timeout_ms,
+                               uint64_t att_size) {
+  std::string rm;
+  pb_put_tag(&rm, 1, 2);
+  pb_put_varint(&rm, svc_len);
+  rm.append(svc, svc_len);
+  pb_put_tag(&rm, 2, 2);
+  pb_put_varint(&rm, meth_len);
+  rm.append(meth, meth_len);
+  if (log_id) {
+    pb_put_tag(&rm, 3, 0);
+    pb_put_varint(&rm, uint64_t(log_id));
+  }
+  if (trace_id) {
+    pb_put_tag(&rm, 4, 0);
+    pb_put_varint(&rm, uint64_t(trace_id));
+  }
+  if (span_id) {
+    pb_put_tag(&rm, 5, 0);
+    pb_put_varint(&rm, uint64_t(span_id));
+  }
+  if (timeout_ms) {
+    pb_put_tag(&rm, 7, 0);
+    pb_put_varint(&rm, uint64_t(uint32_t(timeout_ms)));
+  }
+  std::string meta;
+  pb_put_tag(&meta, 1, 2);
+  pb_put_varint(&meta, rm.size());
+  meta.append(rm);
+  if (cid) {
+    pb_put_tag(&meta, 3, 0);
+    pb_put_varint(&meta, cid);
+  }
+  if (attempt) {
+    pb_put_tag(&meta, 4, 0);
+    pb_put_varint(&meta, attempt);
+  }
+  if (att_size) {
+    pb_put_tag(&meta, 6, 0);
+    pb_put_varint(&meta, att_size);
+  }
+  return meta;
+}
+
+// 12-byte TRPC header in front of a meta+body packet.
+void put_trpc_header(std::string* out, uint64_t meta_size,
+                     uint64_t body_size) {
+  out->append("TRPC", 4);
+  uint32_t ms = htonl(uint32_t(meta_size));
+  uint32_t bs = htonl(uint32_t(body_size));
+  out->append(reinterpret_cast<char*>(&ms), 4);
+  out->append(reinterpret_cast<char*>(&bs), 4);
 }
 
 // --------------------------------------------------------------- data types
@@ -350,6 +521,16 @@ struct Conn {
   bool is_server = false;
   std::atomic<bool> failed{false};
   bool detached = false;
+  // parsed fast-path events enabled (server conns: copied from the
+  // listener at accept; client conns: dp_conn_set_fastpath)
+  std::atomic<bool> py_fast{false};
+
+  // queued dp_respond/dp_call packets awaiting dp_flush_all (one writev
+  // per poll batch instead of one per RPC — single-core syscalls are the
+  // hybrid lane's wall clock)
+  std::mutex pmu;
+  std::string pending;
+  int pending_msgs = 0;
 
   // TPUC tunnel: 0 = plain TCP conn; 1 = negotiating; 2 = ready
   int tpu_mode = 0;
@@ -376,6 +557,8 @@ struct Listener {
   int fd = -1;
   int port = 0;
   int tpu_ordinal = -1;  // >=0: conns speak the TPUC tunnel natively
+  bool py_fast = false;  // parsed EV_REQUEST events for Python services
+  bool logoff = false;   // graceful stop: native services answer ELOGOFF
 };
 
 struct Loop {
@@ -402,14 +585,25 @@ struct Runtime {
   std::deque<DpEvent> events;
   uint64_t event_bytes = 0;
 
+  // Native services run the reference's FULL per-request path in the
+  // engine: admission (logoff + concurrency limit) and method status
+  // (qps/latency/errors) are native, like MethodStatus::OnRequested in
+  // baidu_rpc_protocol.cpp:661-712 — not a policy bypass.
   struct EchoSvc {
     int lid;  // native services are scoped to their listener — one
               // server's fast path must not answer another's traffic
     std::string service;
     std::string method;
+    int32_t max_concurrency = 0;  // 0 = unlimited
+    std::atomic<bool> logoff{false};
+    std::atomic<int32_t> concurrency{0};
+    std::atomic<uint64_t> requests{0};
+    std::atomic<uint64_t> errors{0};
+    std::atomic<uint64_t> latency_sum_ns{0};
+    std::atomic<uint64_t> latency_max_ns{0};
   };
   std::mutex rmu;  // native service registry
-  std::vector<EchoSvc> echo_services;
+  std::vector<std::unique_ptr<EchoSvc>> echo_services;
 
   // TPUC per-conn sender workers: tracked (not detached) so shutdown can
   // quiesce them before the Runtime dies. Finished entries are reaped on
@@ -426,6 +620,10 @@ struct Runtime {
   // by the loop tick once the backoff expires
   std::mutex amu;
   std::vector<std::pair<int, int64_t>> muted_listeners;  // (lid, rearm_ns)
+
+  // conns with queued dp_respond/dp_call packets (dp_flush_all drains)
+  std::mutex fmu;
+  std::vector<std::shared_ptr<Conn>> flush_list;
 };
 
 int64_t mono_ns() {
@@ -468,6 +666,27 @@ void push_event(Runtime* rt, DpEvent ev) {
     // per-message notifies were a futex syscall per frame under load
     rt->ecv.notify_one();
   }
+}
+
+// Batched variant: one lock round trip for a whole parse pass of frames
+// (order within the batch is the conn's arrival order).
+void push_event_batch(Runtime* rt, std::vector<DpEvent>& evs) {
+  if (evs.empty()) return;
+  uint64_t add = 0;
+  for (auto& ev : evs) add += ev.meta_len + ev.body_len + sizeof(DpEvent);
+  std::unique_lock<std::mutex> lk(rt->emu);
+  rt->event_bytes += add;
+  while (rt->running.load() && rt->event_bytes > kEventQueueMaxBytes &&
+         rt->events.size() > 16) {
+    lk.unlock();
+    usleep(1000);
+    lk.lock();
+  }
+  bool was_empty = rt->events.empty();
+  for (auto& ev : evs) rt->events.push_back(ev);
+  if (was_empty) rt->ecv.notify_one();
+  lk.unlock();
+  evs.clear();
 }
 
 void emit_failed(Runtime* rt, Conn* c, int err_class, const char* reason) {
@@ -620,7 +839,8 @@ std::string tpu_hello_json(TpuState* t, int ordinal) {
 }
 
 int conn_writev(Runtime* rt, const std::shared_ptr<Conn>& c,
-                const uint8_t* const* bufs, const uint64_t* lens, int nseg);
+                const uint8_t* const* bufs, const uint64_t* lens, int nseg,
+                int nmsgs = 1);
 int tpu_send_packet(Runtime* rt, const std::shared_ptr<Conn>& c,
                     const uint8_t* const* bufs, const uint64_t* lens,
                     int nseg);
@@ -699,7 +919,8 @@ void conn_fail(Runtime* rt, const std::shared_ptr<Conn>& c, int err_class,
 // = n segments (header/meta/payload/attachment refs from the IOBuf chain);
 // the common case finishes in one writev with ZERO assembly copies.
 int conn_writev(Runtime* rt, const std::shared_ptr<Conn>& c,
-                const uint8_t* const* bufs, const uint64_t* lens, int nseg) {
+                const uint8_t* const* bufs, const uint64_t* lens, int nseg,
+                int nmsgs) {
   uint64_t len = 0;
   for (int i = 0; i < nseg; i++) len += lens[i];
   if (c->failed.load()) return DPE_IO;
@@ -758,7 +979,7 @@ int conn_writev(Runtime* rt, const std::shared_ptr<Conn>& c,
       arm(rt, c.get(), true);
     }
   }
-  c->out_msgs.fetch_add(1, std::memory_order_relaxed);
+  c->out_msgs.fetch_add(uint64_t(nmsgs), std::memory_order_relaxed);
   return DPE_OK;
 }
 
@@ -801,38 +1022,126 @@ void conn_drain_writes(Runtime* rt, const std::shared_ptr<Conn>& c) {
 }
 
 // ----------------------------------------------------------------- parsing
-bool echo_match(Runtime* rt, int lid, const MetaLite& m) {
-  if (lid < 0) return false;
-  std::lock_guard<std::mutex> lk(rt->rmu);
-  for (auto& sm : rt->echo_services) {
-    if (sm.lid == lid && sm.service == m.service && sm.method == m.method) {
-      return true;
+// Accumulators for one parse pass: native echo responses coalesce into a
+// handful of writev calls and delivered events into one queue push — on a
+// single shared core, syscalls and lock round trips ARE the QPS ceiling
+// (reference batches the same way: KeepWrite gathers up to 256 IOBufs,
+// socket.cpp:1789; OnNewMessages NOSIGNAL-batches, input_messenger.cpp:194).
+struct ParseBatch {
+  std::vector<DpEvent> events;
+  // (head, body-ref) pairs; heads in a deque so appends don't move them
+  std::deque<std::string> heads;
+  std::vector<std::pair<const uint8_t*, uint64_t>> segs;
+  int nresp = 0;
+};
+
+// Flush echo responses + events. MUST run before the read buffer is
+// compacted/stolen (segs reference it) and before any conn_fail/detach
+// (frames precede EV_FAILED in the queue).
+void flush_batch(Runtime* rt, const std::shared_ptr<Conn>& c, ParseBatch* b) {
+  if (!b->segs.empty()) {
+    size_t i = 0;
+    bool wrote_err = false;
+    while (i < b->segs.size() && !wrote_err) {
+      const uint8_t* bufs[64];
+      uint64_t lens[64];
+      int n = 0;
+      int msgs = 0;
+      while (i < b->segs.size() && n + 2 <= 64) {
+        bufs[n] = b->segs[i].first;
+        lens[n] = b->segs[i].second;
+        bufs[n + 1] = b->segs[i + 1].first;
+        lens[n + 1] = b->segs[i + 1].second;
+        n += 2;
+        i += 2;
+        msgs++;
+      }
+      int rc = conn_writev(rt, c, bufs, lens, n, msgs);
+      if (rc != DPE_OK) {
+        // a consumed request whose response can't go out leaves the
+        // client hanging — the stream contract is broken, tear down
+        loop_submit(rt, c->loop, [rt, c, rc] {
+          conn_fail(rt, c, rc == DPE_OVERCROWDED ? DPE_OVERCROWDED : DPE_IO,
+                    "native echo response undeliverable");
+        });
+        wrote_err = true;
+      }
     }
+    b->segs.clear();
+    b->heads.clear();
+    b->nresp = 0;
   }
-  return false;
+  push_event_batch(rt, b->events);
 }
 
-// Answer a registered echo request natively: header + rebuilt meta + body
-// copied straight into the write path. Returns false if the frame should
-// go to Python instead.
+Runtime::EchoSvc* echo_match(Runtime* rt, int lid, const MetaLite& m) {
+  if (lid < 0) return nullptr;
+  std::lock_guard<std::mutex> lk(rt->rmu);
+  for (auto& sm : rt->echo_services) {
+    if (sm->lid == lid && sm->service == m.service &&
+        sm->method == m.method) {
+      return sm.get();  // registry only grows; entries are stable
+    }
+  }
+  return nullptr;
+}
+
+// brpc_tpu/rpc/errors.py mirrors (native admission responses)
+constexpr int32_t kElogoff = 1011;
+constexpr int32_t kElimit = 1012;
+
+// Answer a registered echo request natively, running the full native
+// request path: admission (logoff, per-method concurrency limit) +
+// method status (qps/latency/errors) + user code (echo) + response pack.
+// Returns false if the frame should go to Python instead.
 bool try_native_echo(Runtime* rt, const std::shared_ptr<Conn>& c,
                      const MetaLite& m, const uint8_t* body,
-                     uint64_t body_len, RBuf* whole_buf) {
+                     uint64_t body_len, RBuf* whole_buf, ParseBatch* batch) {
   if (!c->is_server || !m.has_request || m.has_response || m.compress_type ||
       m.checksum || m.has_stream_settings || m.has_auth) {
     return false;
   }
   if (m.attachment_size > body_len) return false;
-  if (!echo_match(rt, c->listener_id, m)) return false;
+  Runtime::EchoSvc* svc = echo_match(rt, c->listener_id, m);
+  if (svc == nullptr) return false;
+  int64_t t0 = mono_ns();
+  svc->requests.fetch_add(1, std::memory_order_relaxed);
+  int32_t ecode = 0;
+  const char* etext = "";
+  bool counted = false;
+  if (svc->logoff.load(std::memory_order_relaxed)) {
+    ecode = kElogoff;
+    etext = "server is stopping";
+  } else if (svc->max_concurrency) {
+    int32_t cur = svc->concurrency.fetch_add(
+                      1, std::memory_order_relaxed) + 1;
+    if (cur > svc->max_concurrency) {
+      svc->concurrency.fetch_sub(1, std::memory_order_relaxed);
+      ecode = kElimit;
+      etext = "method concurrency limit";
+    } else {
+      counted = true;
+    }
+  }
+  auto settle = [&](bool is_error) {
+    if (counted) svc->concurrency.fetch_sub(1, std::memory_order_relaxed);
+    if (is_error) svc->errors.fetch_add(1, std::memory_order_relaxed);
+    uint64_t dt = uint64_t(mono_ns() - t0);
+    svc->latency_sum_ns.fetch_add(dt, std::memory_order_relaxed);
+    uint64_t prev = svc->latency_max_ns.load(std::memory_order_relaxed);
+    while (dt > prev &&
+           !svc->latency_max_ns.compare_exchange_weak(prev, dt)) {
+    }
+  };
+  if (ecode) body_len = 0;  // admission rejections carry no body
   std::string head;
   {
-    std::string meta = build_echo_response_meta(m);
+    std::string meta = ecode
+        ? build_response_meta(m.correlation_id, m.attempt_version, ecode,
+                              etext, strlen(etext), 0)
+        : build_echo_response_meta(m);
     head.reserve(kHeaderSize + meta.size());
-    head.append("TRPC", 4);
-    uint32_t ms = htonl(uint32_t(meta.size()));
-    uint32_t bs = htonl(uint32_t(body_len));
-    head.append(reinterpret_cast<char*>(&ms), 4);
-    head.append(reinterpret_cast<char*>(&bs), 4);
+    put_trpc_header(&head, meta.size(), body_len);
     head.append(meta);
   }
   // body still points into the conn's read buffer: conn_writev either puts
@@ -908,38 +1217,19 @@ bool try_native_echo(Runtime* rt, const std::shared_ptr<Conn>& c,
       }
     }
     t->qcv.notify_one();
+    settle(ecode != 0);
     return true;
   }
-  const uint8_t* bufs2[2] = {reinterpret_cast<const uint8_t*>(head.data()),
-                             body};
-  const uint64_t lens2[2] = {head.size(), body_len};
-  int rc = conn_writev(rt, c, bufs2, lens2, 2);
-  if (rc != DPE_OK) {
-    // a consumed request whose response can't be queued leaves the client
-    // hanging — the stream contract is broken, tear the conn down
-    loop_submit(rt, c->loop, [rt, c, rc] {
-      conn_fail(rt, c, rc == DPE_OVERCROWDED ? DPE_OVERCROWDED : DPE_IO,
-                "native echo response undeliverable");
-    });
-  }
+  // TCP lane: accumulate; the whole parse pass flushes in a few writevs
+  // (bodies point into the conn's read buffer, stable until flush)
+  batch->heads.push_back(std::move(head));
+  const std::string& h = batch->heads.back();
+  batch->segs.emplace_back(reinterpret_cast<const uint8_t*>(h.data()),
+                           h.size());
+  batch->segs.emplace_back(body, body_len);
+  batch->nresp++;
+  settle(ecode != 0);
   return true;
-}
-
-void deliver_frame(Runtime* rt, Conn* c, int tag, const uint8_t* meta,
-                   uint64_t meta_len, const uint8_t* body, uint64_t body_len) {
-  uint8_t* blk = static_cast<uint8_t*>(malloc(meta_len + body_len + 1));
-  memcpy(blk, meta, meta_len);
-  memcpy(blk + meta_len, body, body_len);
-  DpEvent ev{};
-  ev.kind = EV_FRAME;
-  ev.tag = tag;
-  ev.conn_id = c->id;
-  ev.base = blk;
-  ev.meta = blk;
-  ev.meta_len = meta_len;
-  ev.body = blk + meta_len;
-  ev.body_len = body_len;
-  push_event(rt, ev);
 }
 
 // Detach: hand the fd + buffered bytes to Python (non-TRPC protocol on a
@@ -971,10 +1261,69 @@ void conn_detach(Runtime* rt, const std::shared_ptr<Conn>& c) {
   rt->conns.erase(c->id);
 }
 
+// Parsed fast-path event builders (meta struct + names/text + body in ONE
+// allocation — dp_free stays a single free()).
+void batch_fast_request(ParseBatch* b, Conn* c, const MetaLite& m,
+                        const uint8_t* body, uint64_t body_len) {
+  size_t hdr = sizeof(ReqLite) + m.service.size() + m.method.size();
+  uint8_t* blk = static_cast<uint8_t*>(malloc(hdr + body_len + 1));
+  ReqLite rl{};
+  rl.cid = m.correlation_id;
+  rl.attempt = m.attempt_version;
+  rl.att_size = m.attachment_size;
+  rl.log_id = m.log_id;
+  rl.trace_id = m.trace_id;
+  rl.span_id = m.span_id;
+  rl.timeout_ms = int32_t(m.timeout_ms);
+  rl.svc_len = uint16_t(m.service.size());
+  rl.meth_len = uint16_t(m.method.size());
+  memcpy(blk, &rl, sizeof(rl));
+  memcpy(blk + sizeof(rl), m.service.data(), m.service.size());
+  memcpy(blk + sizeof(rl) + m.service.size(), m.method.data(),
+         m.method.size());
+  memcpy(blk + hdr, body, body_len);
+  DpEvent ev{};
+  ev.kind = EV_REQUEST;
+  ev.conn_id = c->id;
+  ev.aux = int64_t(m.correlation_id);
+  ev.base = blk;
+  ev.meta = blk;
+  ev.meta_len = hdr;
+  ev.body = blk + hdr;
+  ev.body_len = body_len;
+  b->events.push_back(ev);
+}
+
+void batch_fast_response(ParseBatch* b, Conn* c, const MetaLite& m,
+                         const uint8_t* body, uint64_t body_len) {
+  size_t hdr = sizeof(RespLite) + m.resp_error_text.size();
+  uint8_t* blk = static_cast<uint8_t*>(malloc(hdr + body_len + 1));
+  RespLite rl{};
+  rl.attempt = m.attempt_version;
+  rl.att_size = m.attachment_size;
+  memcpy(blk, &rl, sizeof(rl));
+  memcpy(blk + sizeof(rl), m.resp_error_text.data(),
+         m.resp_error_text.size());
+  memcpy(blk + hdr, body, body_len);
+  DpEvent ev{};
+  ev.kind = EV_RESPONSE;
+  ev.tag = int32_t(m.resp_error_code);
+  ev.conn_id = c->id;
+  ev.aux = int64_t(m.correlation_id);
+  ev.base = blk;
+  ev.meta = blk;
+  ev.meta_len = hdr;
+  ev.body = blk + hdr;
+  ev.body_len = body_len;
+  b->events.push_back(ev);
+}
+
 // Cut complete TRPC/TSTR frames out of (buf, pos) — the wire buffer for
 // plain conns, the reassembled tunnel stream for TPUC conns.
 void cut_trpc(Runtime* rt, const std::shared_ptr<Conn>& c, RBuf& buf,
               size_t& pos, bool allow_detach) {
+  ParseBatch batch;
+  bool fast = c->py_fast.load(std::memory_order_relaxed);
   for (;;) {
     size_t avail = buf.size - pos;
     if (avail < kHeaderSize) break;
@@ -982,6 +1331,7 @@ void cut_trpc(Runtime* rt, const std::shared_ptr<Conn>& c, RBuf& buf,
     bool is_trpc = memcmp(p, "TRPC", 4) == 0;
     bool is_tstr = !is_trpc && memcmp(p, "TSTR", 4) == 0;
     if (!is_trpc && !is_tstr) {
+      flush_batch(rt, c, &batch);  // frames precede the detach/fail event
       if (allow_detach) {
         conn_detach(rt, c);
       } else {
@@ -993,6 +1343,7 @@ void cut_trpc(Runtime* rt, const std::shared_ptr<Conn>& c, RBuf& buf,
     uint32_t body_size = ntohl(*reinterpret_cast<const uint32_t*>(p + 8));
     uint64_t total = uint64_t(meta_size) + body_size;
     if (total > rt->max_body) {
+      flush_batch(rt, c, &batch);
       conn_fail(rt, c, DPE_PROTOCOL, "frame exceeds max_body");
       return;
     }
@@ -1002,23 +1353,31 @@ void cut_trpc(Runtime* rt, const std::shared_ptr<Conn>& c, RBuf& buf,
     c->in_msgs.fetch_add(1, std::memory_order_relaxed);
     bool handled = false;
     bool whole = (pos == 0 && kHeaderSize + total == buf.size);
+    MetaLite m;
+    bool meta_ok = false;
     if (is_trpc) {
-      MetaLite m;
       if (parse_meta_lite(meta, meta + meta_size, &m)) {
+        meta_ok = true;
         handled = try_native_echo(rt, c, m, body, body_size,
-                                  whole ? &buf : nullptr);
+                                  whole ? &buf : nullptr, &batch);
         if (handled && buf.data == nullptr) {
-          pos = 0;  // the echo stole the buffer
+          pos = 0;  // the echo stole the buffer (tpu lane, single frame:
+                    // batch is necessarily empty of body refs)
+          flush_batch(rt, c, &batch);
+          return;
+        }
+        if (c->failed.load()) {  // tpu-lane echo enqueue tore it down
+          flush_batch(rt, c, &batch);
           return;
         }
       } else {
+        flush_batch(rt, c, &batch);
         conn_fail(rt, c, DPE_PROTOCOL, "bad RpcMeta");
         return;
       }
     }
     if (!handled) {
-      if (pos == 0 && kHeaderSize + total == buf.size &&
-          total >= (64 << 10)) {
+      if (whole && total >= kFastFrameMax) {
         // the buffer holds exactly this one large frame: hand the WHOLE
         // buffer to the consumer instead of memcpy'ing megabytes — the
         // dominant copy on the delivery path (this machine is single-core;
@@ -1036,14 +1395,46 @@ void cut_trpc(Runtime* rt, const std::shared_ptr<Conn>& c, RBuf& buf,
         buf.cap = 0;
         buf.size = 0;
         pos = 0;
-        push_event(rt, ev);
+        batch.events.push_back(ev);
+        flush_batch(rt, c, &batch);
         return;
       }
-      deliver_frame(rt, c.get(), is_tstr ? 1 : 0, meta, meta_size, body,
-                    body_size);
+      // parsed fast-path events: Python receives pre-cracked meta fields
+      // and never runs protobuf on the hot path. Anything with policy
+      // riding the meta (compress, checksum, auth, streams) takes the
+      // full EV_FRAME path; trace ids ride ReqLite natively.
+      if (fast && is_trpc && meta_ok && !m.compress_type && !m.checksum &&
+          !m.has_stream_settings && !m.has_auth &&
+          m.attachment_size <= body_size) {
+        if (c->is_server && m.has_request && !m.has_response) {
+          batch_fast_request(&batch, c.get(), m, body, body_size);
+          pos += kHeaderSize + total;
+          continue;
+        }
+        if (!c->is_server && m.has_response && !m.has_request) {
+          batch_fast_response(&batch, c.get(), m, body, body_size);
+          pos += kHeaderSize + total;
+          continue;
+        }
+      }
+      uint8_t* blk = static_cast<uint8_t*>(
+          malloc(uint64_t(meta_size) + body_size + 1));
+      memcpy(blk, meta, meta_size);
+      memcpy(blk + meta_size, body, body_size);
+      DpEvent ev{};
+      ev.kind = EV_FRAME;
+      ev.tag = is_tstr ? 1 : 0;
+      ev.conn_id = c->id;
+      ev.base = blk;
+      ev.meta = blk;
+      ev.meta_len = meta_size;
+      ev.body = blk + meta_size;
+      ev.body_len = body_size;
+      batch.events.push_back(ev);
     }
     pos += kHeaderSize + total;
   }
+  flush_batch(rt, c, &batch);  // before compaction: segs reference buf
   // compact
   if (pos == buf.size) {
     buf.size = 0;
@@ -1473,6 +1864,56 @@ int tpu_send_packet(Runtime* rt, const std::shared_ptr<Conn>& c,
   return DPE_OK;
 }
 
+// --------------------------------------------- queued packets (fast path)
+// dp_respond/dp_call with queue=1 append whole packets here; dp_flush_all
+// drains every queued conn in one writev each. The Python poller answers a
+// whole poll batch, then flushes once — syscalls per RPC drop below one.
+void queue_packet(Runtime* rt, const std::shared_ptr<Conn>& c,
+                  const std::string& head, const uint8_t* payload,
+                  uint64_t plen, const uint8_t* att, uint64_t alen) {
+  bool first = false;
+  {
+    std::lock_guard<std::mutex> lk(c->pmu);
+    first = c->pending.empty();
+    c->pending.reserve(c->pending.size() + head.size() + plen + alen);
+    c->pending.append(head);
+    if (plen) c->pending.append(reinterpret_cast<const char*>(payload),
+                                size_t(plen));
+    if (alen) c->pending.append(reinterpret_cast<const char*>(att),
+                                size_t(alen));
+    c->pending_msgs++;
+  }
+  if (first) {
+    std::lock_guard<std::mutex> lk(rt->fmu);
+    rt->flush_list.push_back(c);
+  }
+}
+
+int flush_conn_pending(Runtime* rt, const std::shared_ptr<Conn>& c) {
+  std::string out;
+  int msgs = 0;
+  {
+    std::lock_guard<std::mutex> lk(c->pmu);
+    out.swap(c->pending);
+    msgs = c->pending_msgs;
+    c->pending_msgs = 0;
+  }
+  if (out.empty()) return DPE_OK;
+  const uint8_t* b[1] = {reinterpret_cast<const uint8_t*>(out.data())};
+  const uint64_t l[1] = {out.size()};
+  int rc = c->tpu_mode != 0 ? tpu_send_packet(rt, c, b, l, 1)
+                            : conn_writev(rt, c, b, l, 1, msgs);
+  if (rc != DPE_OK && !c->failed.load()) {
+    // queued responses that can't go out leave callers hanging forever —
+    // same contract breach as the native echo path: tear down
+    loop_submit(rt, c->loop, [rt, c, rc] {
+      conn_fail(rt, c, rc == DPE_OVERCROWDED ? DPE_OVERCROWDED : DPE_IO,
+                "queued packet undeliverable");
+    });
+  }
+  return rc;
+}
+
 // ------------------------------------------------------------ registration
 std::shared_ptr<Conn> create_conn(Runtime* rt, int fd, bool is_server) {
   auto c = std::make_shared<Conn>();
@@ -1500,12 +1941,14 @@ void activate_conn(Runtime* rt, const std::shared_ptr<Conn>& c) {
 
 void accept_ready(Runtime* rt, int lid) {
   int lfd = -1;
+  bool py_fast = false;
   {
     // dp_listen may grow the vector and dp_listener_close retire the fd
     // concurrently — snapshot under the lock
     std::lock_guard<std::mutex> lk(rt->cmu);
     if (lid < 0 || size_t(lid) >= rt->listeners.size()) return;
     lfd = rt->listeners[size_t(lid)].fd;
+    py_fast = rt->listeners[size_t(lid)].py_fast;
   }
   if (lfd < 0) return;
   for (;;) {
@@ -1533,6 +1976,7 @@ void accept_ready(Runtime* rt, int lid) {
     setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
     auto c = create_conn(rt, fd, /*is_server=*/true);
     c->listener_id = lid;
+    c->py_fast.store(py_fast, std::memory_order_relaxed);
     char host[NI_MAXHOST] = "?", serv[NI_MAXSERV] = "0";
     getnameinfo(reinterpret_cast<sockaddr*>(&ss), slen, host, sizeof(host),
                 serv, sizeof(serv), NI_NUMERICHOST | NI_NUMERICSERV);
@@ -1644,7 +2088,7 @@ void loop_run(Runtime* rt, int li) {
 // ===================================================================== ABI
 extern "C" {
 
-int dp_abi_version() { return 1; }
+int dp_abi_version() { return 2; }
 
 void* dp_rt_create(int nloops, uint64_t max_body) {
   if (nloops <= 0) nloops = 2;
@@ -1707,6 +2151,10 @@ void dp_rt_shutdown(void* h) {
     rt->senders.clear();
   }
   conns.clear();
+  {
+    std::lock_guard<std::mutex> lk(rt->fmu);
+    rt->flush_list.clear();
+  }
   {
     std::lock_guard<std::mutex> lk(rt->emu);
     for (auto& ev : rt->events) free(ev.base);
@@ -1795,22 +2243,68 @@ int dp_register_echo(void* h, int lid, const char* service,
                      const char* method) {
   auto* rt = static_cast<Runtime*>(h);
   if (lid < 0) return -1;
+  auto svc = std::make_unique<Runtime::EchoSvc>();
+  svc->lid = lid;
+  svc->service = service;
+  svc->method = method;
   std::lock_guard<std::mutex> lk(rt->rmu);
-  rt->echo_services.push_back({lid, service, method});
+  rt->echo_services.push_back(std::move(svc));
   return 0;
 }
 
-// drop a listener's native services (Server teardown)
+// drop a listener's native services (Server teardown). Entries are marked
+// dead, not freed: a loop thread may hold an EchoSvc* across the
+// unregister (pointers stay valid for the runtime's lifetime).
 int dp_unregister_listener_echoes(void* h, int lid) {
   auto* rt = static_cast<Runtime*>(h);
   std::lock_guard<std::mutex> lk(rt->rmu);
-  rt->echo_services.erase(
-      std::remove_if(rt->echo_services.begin(), rt->echo_services.end(),
-                     [lid](const Runtime::EchoSvc& e) {
-                       return e.lid == lid;
-                     }),
-      rt->echo_services.end());
+  for (auto& e : rt->echo_services) {
+    if (e->lid == lid) e->lid = -2;
+  }
   return 0;
+}
+
+// per-method concurrency limit for a native service (MethodStatus analog)
+int dp_svc_set_limit(void* h, int lid, const char* service,
+                     const char* method, int max_concurrency) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::lock_guard<std::mutex> lk(rt->rmu);
+  for (auto& e : rt->echo_services) {
+    if (e->lid == lid && e->service == service && e->method == method) {
+      e->max_concurrency = max_concurrency;
+      return 0;
+    }
+  }
+  return -1;
+}
+
+// graceful-stop: native services of this listener answer ELOGOFF
+int dp_listener_set_logoff(void* h, int lid, int on) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::lock_guard<std::mutex> lk(rt->rmu);
+  for (auto& e : rt->echo_services) {
+    if (e->lid == lid) e->logoff.store(on != 0, std::memory_order_relaxed);
+  }
+  return 0;
+}
+
+// method status counters for a native service (surfaced at /status)
+int dp_svc_stats(void* h, int lid, const char* service, const char* method,
+                 uint64_t* requests, uint64_t* errs, uint64_t* latency_sum_ns,
+                 uint64_t* latency_max_ns, int32_t* concurrency) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::lock_guard<std::mutex> lk(rt->rmu);
+  for (auto& e : rt->echo_services) {
+    if (e->lid == lid && e->service == service && e->method == method) {
+      *requests = e->requests.load(std::memory_order_relaxed);
+      *errs = e->errors.load(std::memory_order_relaxed);
+      *latency_sum_ns = e->latency_sum_ns.load(std::memory_order_relaxed);
+      *latency_max_ns = e->latency_max_ns.load(std::memory_order_relaxed);
+      *concurrency = e->concurrency.load(std::memory_order_relaxed);
+      return 0;
+    }
+  }
+  return -1;
 }
 
 // Returns conn id > 0, or 0 with *err_out=errno.
@@ -1962,6 +2456,108 @@ int dp_sendv(void* h, uint64_t conn_id, const uint8_t* const* bufs,
   return conn_writev(rt, c, bufs, lens, nseg);
 }
 
+// Enable parsed EV_REQUEST events for a listener's conns (Python servers
+// that understand the fast path flip this right after dp_listen).
+int dp_listener_set_fastpath(void* h, int lid, int on) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::lock_guard<std::mutex> lk(rt->cmu);
+  if (lid < 0 || size_t(lid) >= rt->listeners.size()) return -1;
+  rt->listeners[size_t(lid)].py_fast = on != 0;
+  return 0;
+}
+
+// Enable parsed EV_RESPONSE events for a client conn.
+int dp_conn_set_fastpath(void* h, uint64_t conn_id, int on) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::lock_guard<std::mutex> lk(rt->cmu);
+  auto it = rt->conns.find(conn_id);
+  if (it == rt->conns.end()) return -1;
+  it->second->py_fast.store(on != 0, std::memory_order_relaxed);
+  return 0;
+}
+
+// Server response, packed natively (server_processing._send_response with
+// zero Python protobuf). queue=1 defers the write to dp_flush_all.
+int dp_respond(void* h, uint64_t conn_id, uint64_t cid, uint64_t attempt,
+               int error_code, const char* etext, uint64_t etext_len,
+               const uint8_t* payload, uint64_t plen, const uint8_t* att,
+               uint64_t alen, int compress_type, int queue) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::shared_ptr<Conn> c;
+  {
+    std::lock_guard<std::mutex> lk(rt->cmu);
+    auto it = rt->conns.find(conn_id);
+    if (it != rt->conns.end()) c = it->second;
+  }
+  if (!c) return DPE_NOTFOUND;
+  std::string meta = build_response_meta(cid, attempt, error_code, etext,
+                                         etext_len, alen,
+                                         int32_t(compress_type));
+  std::string head;
+  head.reserve(kHeaderSize + meta.size());
+  put_trpc_header(&head, meta.size(), plen + alen);
+  head.append(meta);
+  if (queue) {
+    queue_packet(rt, c, head, payload, plen, att, alen);
+    return DPE_OK;
+  }
+  const uint8_t* bufs[3] = {reinterpret_cast<const uint8_t*>(head.data()),
+                            payload, att};
+  const uint64_t lens[3] = {head.size(), plen, alen};
+  int nseg = alen ? 3 : (plen ? 2 : 1);
+  if (c->tpu_mode != 0) return tpu_send_packet(rt, c, bufs, lens, nseg);
+  return conn_writev(rt, c, bufs, lens, nseg);
+}
+
+// Client request, packed natively (Controller._issue_rpc's meta build with
+// zero Python protobuf). queue=1 defers the write to dp_flush_all.
+int dp_call(void* h, uint64_t conn_id, const char* svc, uint64_t svc_len,
+            const char* meth, uint64_t meth_len, uint64_t cid,
+            uint64_t attempt, int64_t log_id, int64_t trace_id,
+            int64_t span_id, int32_t timeout_ms, const uint8_t* payload,
+            uint64_t plen, const uint8_t* att, uint64_t alen, int queue) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::shared_ptr<Conn> c;
+  {
+    std::lock_guard<std::mutex> lk(rt->cmu);
+    auto it = rt->conns.find(conn_id);
+    if (it != rt->conns.end()) c = it->second;
+  }
+  if (!c) return DPE_NOTFOUND;
+  std::string meta = build_request_meta(svc, svc_len, meth, meth_len, cid,
+                                        attempt, log_id, trace_id, span_id,
+                                        timeout_ms, alen);
+  std::string head;
+  head.reserve(kHeaderSize + meta.size());
+  put_trpc_header(&head, meta.size(), plen + alen);
+  head.append(meta);
+  if (queue) {
+    queue_packet(rt, c, head, payload, plen, att, alen);
+    return DPE_OK;
+  }
+  const uint8_t* bufs[3] = {reinterpret_cast<const uint8_t*>(head.data()),
+                            payload, att};
+  const uint64_t lens[3] = {head.size(), plen, alen};
+  int nseg = alen ? 3 : (plen ? 2 : 1);
+  if (c->tpu_mode != 0) return tpu_send_packet(rt, c, bufs, lens, nseg);
+  return conn_writev(rt, c, bufs, lens, nseg);
+}
+
+// Drain every conn with queued packets (call once per answered poll batch).
+int dp_flush_all(void* h) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::vector<std::shared_ptr<Conn>> conns;
+  {
+    std::lock_guard<std::mutex> lk(rt->fmu);
+    conns.swap(rt->flush_list);
+  }
+  int bad = 0;
+  for (auto& c : conns) {
+    if (flush_conn_pending(rt, c) != DPE_OK) bad++;
+  }
+  return bad;
+}
+
 int dp_poll(void* h, DpEvent* out, int maxn, int timeout_ms) {
   auto* rt = static_cast<Runtime*>(h);
   std::unique_lock<std::mutex> lk(rt->emu);
@@ -2048,6 +2644,8 @@ int dp_bench_echo2(const char* host, int port, int use_tpu, int nconns,
       dp_rt_shutdown(h);
       return -1;
     }
+    // parsed EV_RESPONSE completions: cid arrives pre-cracked in ev.aux
+    dp_conn_set_fastpath(h, cid, 1);
     conns.push_back(cid);
   }
   std::atomic<uint64_t> done_count{0}, errors_seen{0};
@@ -2062,23 +2660,18 @@ int dp_bench_echo2(const char* host, int port, int use_tpu, int nconns,
     clock_gettime(CLOCK_MONOTONIC, &ts);
     return int64_t(ts.tv_sec) * 1000000000 + ts.tv_nsec;
   };
+  // queued sends (one writev per conn per poll batch via dp_flush_all —
+  // the same batched lane the Python fast path drives)
+  // queueing copies the payload once — a win for small frames (syscalls
+  // dominate), a loss for MB-scale ones (writev from the caller's buffer)
+  const int q_mode = payload_len < (64 << 10) ? 1 : 0;
   auto send_one = [&](int conn_idx, int slot) {
     uint64_t cid = uint64_t(conn_idx) * depth + slot + 1;
-    std::string meta = reqmeta_tail;
-    pb_put_tag(&meta, 3, 0);
-    pb_put_varint(&meta, cid);
-    char hdr[kHeaderSize];
-    memcpy(hdr, "TRPC", 4);
-    uint32_t ms = htonl(uint32_t(meta.size()));
-    uint32_t bs = htonl(uint32_t(body.size()));
-    memcpy(hdr + 4, &ms, 4);
-    memcpy(hdr + 8, &bs, 4);
-    const uint8_t* bufs[3] = {reinterpret_cast<uint8_t*>(hdr),
-                              reinterpret_cast<const uint8_t*>(meta.data()),
-                              reinterpret_cast<const uint8_t*>(body.data())};
-    const uint64_t lens[3] = {kHeaderSize, meta.size(), body.size()};
     sent_ns[cid - 1].store(now_ns(), std::memory_order_relaxed);
-    return dp_sendv(h, conns[size_t(conn_idx)], bufs, lens, 3);
+    return dp_call(h, conns[size_t(conn_idx)], service, strlen(service),
+                   method, strlen(method), cid, 0, 0, 0, 0, 0,
+                   reinterpret_cast<const uint8_t*>(body.data()),
+                   body.size(), nullptr, 0, q_mode);
   };
   // prime the pipeline
   for (int ci = 0; ci < nconns; ci++) {
@@ -2089,6 +2682,7 @@ int dp_bench_echo2(const char* host, int port, int use_tpu, int nconns,
       }
     }
   }
+  dp_flush_all(h);
   int64_t t_start = now_ns();
   int64_t t_end = t_start + int64_t(duration_ms) * 1000000;
   // consumer: poll completions, re-issue (the framework's event queue IS
@@ -2097,31 +2691,39 @@ int dp_bench_echo2(const char* host, int port, int use_tpu, int nconns,
   while (!stop.load()) {
     int n = dp_poll(h, evs.data(), int(evs.size()), 50);
     int64_t now = now_ns();
+    bool queued = false;
     for (int i = 0; i < n; i++) {
       DpEvent& ev = evs[i];
-      if (ev.kind == EV_FRAME) {
+      uint64_t cid = 0;
+      if (ev.kind == EV_RESPONSE) {
+        cid = uint64_t(ev.aux);
+      } else if (ev.kind == EV_FRAME) {
+        // big frames (>=64KB) still arrive as donated EV_FRAME buffers
         MetaLite m;
         const uint8_t* mp = static_cast<const uint8_t*>(ev.meta);
-        if (parse_meta_lite(mp, mp + ev.meta_len, &m) && m.correlation_id &&
-            m.correlation_id <= uint64_t(nconns) * uint64_t(depth)) {
-          uint64_t cid = m.correlation_id;
-          int64_t t0 = sent_ns[cid - 1].load(std::memory_order_relaxed);
-          {
-            std::lock_guard<std::mutex> lk(lat_mu);
-            latencies.push_back(double(now - t0) / 1000.0);
-          }
-          done_count.fetch_add(1);
-          if (now < t_end) {
-            int conn_idx = int((cid - 1) / depth);
-            int slot = int((cid - 1) % depth);
-            send_one(conn_idx, slot);
-          }
+        if (parse_meta_lite(mp, mp + ev.meta_len, &m)) {
+          cid = m.correlation_id;
         }
       } else if (ev.kind == EV_FAILED) {
         errors_seen.fetch_add(1);
       }
+      if (cid && cid <= uint64_t(nconns) * uint64_t(depth)) {
+        int64_t t0 = sent_ns[cid - 1].load(std::memory_order_relaxed);
+        {
+          std::lock_guard<std::mutex> lk(lat_mu);
+          latencies.push_back(double(now - t0) / 1000.0);
+        }
+        done_count.fetch_add(1);
+        if (now < t_end) {
+          int conn_idx = int((cid - 1) / depth);
+          int slot = int((cid - 1) % depth);
+          send_one(conn_idx, slot);
+          queued = true;
+        }
+      }
       free(ev.base);
     }
+    if (queued) dp_flush_all(h);
     if (now >= t_end) {
       // drain stragglers briefly, then stop
       static const int64_t grace = 200000000;

@@ -26,8 +26,10 @@ processes inline_process protocols (stream frames) in poll order.
 from __future__ import annotations
 
 import ctypes
+import itertools
 import logging
 import socket as _socket
+import struct
 import threading
 import time as _time
 from typing import Dict, Optional, Set, Tuple
@@ -47,6 +49,51 @@ EV_FRAME = 1
 EV_FAILED = 2
 EV_ACCEPTED = 3
 EV_DETACHED = 4
+EV_REQUEST = 5   # engine-parsed unary request (ReqLite struct + body)
+EV_RESPONSE = 6  # engine-parsed unary response (RespLite struct + body)
+
+# ReqLite / RespLite (dataplane.cpp mirrors, host endianness)
+_REQ_STRUCT = struct.Struct("<QQQqqqiHH")  # cid,att_v,att,log,trace,span,to,sl,ml
+_RESP_ATT = struct.Struct("<Q")           # att_size at offset 8
+_RESP_HDR = 16
+
+# fast-call correlation ids live far above the call_id pool's id space so
+# the two completion routes can never collide on the wire
+_fast_cid = itertools.count(1 << 40)
+
+
+class FastCallRec:
+    """In-flight fast-path call: the completion slot the poller fills.
+
+    The fast lane (channel.py _fast_call <-> dp_call/dp_respond) replaces
+    protobuf meta pack/parse + versioned call-id locks with a dict entry
+    and an Event — the reference keeps this per-RPC machinery native
+    (baidu_rpc_protocol.cpp ProcessRpcResponse); so do we."""
+
+    __slots__ = ("event", "code", "text", "body", "att_size", "deadline",
+                 "on_complete", "inline_done")
+
+    def __init__(self):
+        self.event: Optional[threading.Event] = None
+        self.code = 0
+        self.text = ""
+        self.body = b""
+        self.att_size = 0
+        self.deadline = 0.0          # monotonic; async calls swept by poller
+        self.on_complete = None      # async: callable(rec)
+        self.inline_done = False     # async: run on_complete on the poller
+
+    def finish(self) -> None:
+        cb = self.on_complete
+        if cb is None:
+            self.event.set()
+        elif self.inline_done:
+            try:
+                cb(self)
+            except Exception:
+                log.exception("fast-call inline completion failed")
+        else:
+            _runtime.start_background(cb, self)
 
 # error classes
 DPE_OK = 0
@@ -94,6 +141,7 @@ class NativeSocket:
         self._sweep_msgs = 0  # engine-counter baseline for the idle sweep
         self._pending_ids: Set[int] = set()
         self._pending_lock = threading.Lock()
+        self._fast_calls: Dict[int, FastCallRec] = {}  # cid -> rec
         self.on_failed_hook = None
         self.socket_id = _vsock_pool.insert(self)
 
@@ -154,6 +202,15 @@ class NativeSocket:
         self._dp._drop_socket(self.conn_id)
         for cid in pending:
             _cid.id_error(cid, code)
+        fast = self._fast_calls
+        while fast:
+            try:
+                fcid, rec = fast.popitem()
+            except KeyError:
+                break
+            rec.code = code
+            rec.text = reason or "connection failed"
+            rec.finish()
         hook = self.on_failed_hook
         if hook is not None:
             try:
@@ -209,11 +266,36 @@ class NativeDataplane:
         # user done callbacks must not run (and possibly block) on the
         # poller — controller defers them to fibers when it sees this flag
         self._poller.brpc_no_user_code = True
+        # threads that end every batch with dp_flush_all may queue packets
+        self._poller.brpc_fast_flusher = True
         self._poller.start()
 
     # --------------------------------------------------------------- engine
     def send(self, conn_id: int, payload: bytes) -> int:
         return self._lib.dp_send(self._rt, conn_id, payload, len(payload))
+
+    def call(self, conn_id: int, service: bytes, method: bytes, cid: int,
+             attempt: int, log_id: int, timeout_ms: int, payload: bytes,
+             attachment: bytes, queue: bool, trace_id: int = 0,
+             span_id: int = 0) -> int:
+        """Request packet packed + written by the engine (no Python pb)."""
+        return self._lib.dp_call(
+            self._rt, conn_id, service, len(service), method, len(method),
+            cid, attempt, log_id, trace_id, span_id, timeout_ms,
+            payload, len(payload), attachment, len(attachment),
+            1 if queue else 0)
+
+    def respond(self, conn_id: int, cid: int, attempt: int, code: int,
+                text: bytes, payload: bytes, attachment: bytes,
+                queue: bool, compress_type: int = 0) -> int:
+        """Response packet packed + written by the engine (no Python pb)."""
+        return self._lib.dp_respond(
+            self._rt, conn_id, cid, attempt, code, text, len(text),
+            payload, len(payload), attachment, len(attachment),
+            compress_type, 1 if queue else 0)
+
+    def flush_all(self) -> None:
+        self._lib.dp_flush_all(self._rt)
 
     def sendv_iobuf(self, conn_id: int, buf: IOBuf) -> Tuple[int, int]:
         """Write an IOBuf's ref chain without flattening: each ref that spans
@@ -245,14 +327,18 @@ class NativeDataplane:
         self._lib.dp_conn_close(self._rt, conn_id)
 
     def listen(self, server, host: str, port: int,
-               tpu_ordinal: int = -1) -> Tuple[int, int]:
+               tpu_ordinal: int = -1, fastpath: bool = False) -> Tuple[int, int]:
         """Returns (listener_id, bound_port); raises OSError on failure.
-        tpu_ordinal >= 0 makes accepted TPUC handshakes native tunnels."""
+        tpu_ordinal >= 0 makes accepted TPUC handshakes native tunnels;
+        fastpath=True makes the engine deliver parsed EV_REQUEST events
+        for plain unary requests (meta-free Python dispatch)."""
         lid = self._lib.dp_listen(self._rt, host.encode(), port)
         if lid < 0:
             raise OSError(-lid, f"dp_listen({host}:{port})")
         if tpu_ordinal >= 0:
             self._lib.dp_listener_set_tpu(self._rt, lid, tpu_ordinal)
+        if fastpath:
+            self._lib.dp_listener_set_fastpath(self._rt, lid, 1)
         bound = self._lib.dp_listen_port(self._rt, lid)
         with self._lock:
             self._servers[lid] = server
@@ -282,11 +368,41 @@ class NativeDataplane:
         self.stop_listening(lid)
         self.teardown_listener(lid)
 
-    def register_echo(self, lid: int, service: str, method: str) -> None:
+    def register_echo(self, lid: int, service: str, method: str,
+                      max_concurrency: int = 0) -> None:
         """Native services are LISTENER-scoped: one server's C++ fast path
         must never answer another server's traffic in the same process."""
         self._lib.dp_register_echo(self._rt, lid, service.encode(),
                                    method.encode())
+        if max_concurrency:
+            self._lib.dp_svc_set_limit(self._rt, lid, service.encode(),
+                                       method.encode(), max_concurrency)
+
+    def set_listener_logoff(self, lid: int, on: bool) -> None:
+        self._lib.dp_listener_set_logoff(self._rt, lid, 1 if on else 0)
+
+    def svc_stats(self, lid: int, service: str, method: str):
+        """Native method status: dict(requests, errors, latency_avg_us,
+        latency_max_us, concurrency) or None."""
+        req = ctypes.c_uint64()
+        errs = ctypes.c_uint64()
+        lat_sum = ctypes.c_uint64()
+        lat_max = ctypes.c_uint64()
+        conc = ctypes.c_int32()
+        rc = self._lib.dp_svc_stats(
+            self._rt, lid, service.encode(), method.encode(),
+            ctypes.byref(req), ctypes.byref(errs), ctypes.byref(lat_sum),
+            ctypes.byref(lat_max), ctypes.byref(conc))
+        if rc != 0:
+            return None
+        n = req.value
+        return {
+            "requests": n,
+            "errors": errs.value,
+            "latency_avg_us": (lat_sum.value / n / 1000.0) if n else 0.0,
+            "latency_max_us": lat_max.value / 1000.0,
+            "concurrency": conc.value,
+        }
 
     def connect(self, ep: EndPoint, timeout_ms: int = 3000) -> NativeSocket:
         err = ctypes.c_int(0)
@@ -297,6 +413,8 @@ class NativeDataplane:
                 f"native connect to {ep} failed: errno={err.value}")
         sock = NativeSocket(self, conn, ep, is_server=False)
         self.register_socket(conn, sock)
+        # parsed EV_RESPONSE completions for plain unary responses
+        self._lib.dp_conn_set_fastpath(self._rt, conn, 1)
         return sock
 
     def connect_tpu(self, ep: EndPoint,
@@ -313,6 +431,7 @@ class NativeDataplane:
                 f"native tpu connect to {ep} failed: errno={err.value}")
         sock = NativeSocket(self, conn, ep, is_server=False)
         self.register_socket(conn, sock)
+        self._lib.dp_conn_set_fastpath(self._rt, conn, 1)
         return sock
 
     def get_or_connect(self, ep: EndPoint,
@@ -389,18 +508,115 @@ class NativeDataplane:
     def _poll_loop(self) -> None:
         lib = self._lib
         events = self._events
+        rt = self._rt
+        last_sweep = _time.monotonic()
         while self._running:
-            n = lib.dp_poll(self._rt, events, self.POLL_BATCH, 200)
+            n = lib.dp_poll(rt, events, self.POLL_BATCH, 200)
             for i in range(n):
                 ev = events[i]
                 try:
-                    self._dispatch(ev)
+                    kind = ev.kind
+                    if kind == EV_RESPONSE:
+                        self._on_fast_response(ev)
+                    elif kind == EV_REQUEST:
+                        item = self._crack_fast_request(ev)
+                        if item is not None:
+                            if item[0].options.usercode_inline:
+                                # reference default: user code runs in the
+                                # parsing thread; responses batch-flush
+                                _fast_process_request(item)
+                            else:
+                                # fiber per request — blocking handlers
+                                # stay concurrent (slow-path semantics)
+                                _runtime.start_background(
+                                    _fast_process_request, item)
+                    else:
+                        self._dispatch(ev)
                 except Exception:
                     log.exception("native event dispatch failed (kind=%d)",
                                   ev.kind)
                 finally:
                     if ev.base:
                         lib.dp_free(ev.base)
+            if n:
+                lib.dp_flush_all(rt)  # queued inline responses go out now
+            now = _time.monotonic()
+            if now - last_sweep > 0.1:
+                last_sweep = now
+                self._sweep_fast_timeouts(now)
+
+    # ------------------------------------------------------- fast-path events
+    def _crack_fast_request(self, ev):
+        """EV_REQUEST -> dispatch tuple (engine already parsed the meta)."""
+        sock = self._socks.get(ev.conn_id)  # GIL-atomic read, hot path
+        if sock is None:
+            return None  # conn already failed/removed; nobody to answer
+        server = sock.owner_server
+        if server is None:
+            return None
+        meta_b = ctypes.string_at(ev.meta, ev.meta_len)
+        (cid, attempt, att_size, log_id, trace_id, span_id, timeout_ms,
+         svc_len, meth_len) = _REQ_STRUCT.unpack_from(meta_b)
+        svc_off = _REQ_STRUCT.size
+        svc = meta_b[svc_off:svc_off + svc_len].decode("utf-8", "replace")
+        meth = meta_b[svc_off + svc_len:svc_off + svc_len + meth_len].decode(
+            "utf-8", "replace")
+        body = ctypes.string_at(ev.body, ev.body_len) if ev.body_len else b""
+        sock.in_messages += 1
+        sock.in_bytes += ev.meta_len + ev.body_len
+        sock.last_active = _time.monotonic()
+        return (server, sock, svc, meth, cid, attempt, att_size, log_id,
+                trace_id, span_id, timeout_ms, body)
+
+    def _on_fast_response(self, ev) -> None:
+        sock = self._socks.get(ev.conn_id)
+        cid = ev.aux
+        rec = sock._fast_calls.pop(cid, None) if sock is not None else None
+        meta_b = ctypes.string_at(ev.meta, ev.meta_len) if ev.meta_len else b""
+        if rec is not None:
+            rec.code = ev.tag
+            if ev.tag and len(meta_b) > _RESP_HDR:
+                rec.text = meta_b[_RESP_HDR:].decode("utf-8", "replace")
+            rec.att_size = _RESP_ATT.unpack_from(meta_b, 8)[0]
+            rec.body = ctypes.string_at(ev.body, ev.body_len) \
+                if ev.body_len else b""
+            sock.in_messages += 1
+            sock.in_bytes += ev.meta_len + ev.body_len
+            rec.finish()
+            return
+        if sock is None:
+            return
+        # a slow-path (full Controller) call completed on a fast conn:
+        # rebuild the RpcMeta and take the normal completion route
+        meta = rpc_meta_pb2.RpcMeta()
+        meta.correlation_id = cid
+        meta.attempt_version = int.from_bytes(meta_b[0:8], "little")
+        meta.attachment_size = _RESP_ATT.unpack_from(meta_b, 8)[0]
+        meta.response.error_code = ev.tag
+        if ev.tag and len(meta_b) > _RESP_HDR:
+            meta.response.error_text = meta_b[_RESP_HDR:].decode(
+                "utf-8", "replace")
+        body_b = ctypes.string_at(ev.body, ev.body_len) if ev.body_len else b""
+        self._process_frame(sock, 0, None, body_b, prebuilt_meta=meta)
+
+    def _sweep_fast_timeouts(self, now: float) -> None:
+        """Async fast calls have no per-call timer (that is the point);
+        the poller sweeps deadlines coarsely instead. Sync calls time out
+        in their own wait and are skipped here."""
+        with self._lock:
+            socks = list(self._socks.values())
+        for sock in socks:
+            fast = sock._fast_calls
+            if not fast:
+                continue
+            for fcid, rec in list(fast.items()):
+                if rec.on_complete is None or not rec.deadline \
+                        or now < rec.deadline:
+                    continue
+                if fast.pop(fcid, None) is not None:
+                    rec.code = errors.ERPCTIMEDOUT
+                    rec.text = "fast-call deadline exceeded"
+                    rec.finish()
 
     def _dispatch(self, ev) -> None:
         kind = ev.kind
@@ -457,14 +673,17 @@ class NativeDataplane:
         if len(self._orphans) > 1024:
             self._orphans.clear()
 
-    def _process_frame(self, sock: NativeSocket, tag: int, meta_b: bytes,
-                       body_b: bytes) -> None:
+    def _process_frame(self, sock: NativeSocket, tag: int, meta_b,
+                       body_b: bytes, prebuilt_meta=None) -> None:
         from brpc_tpu.rpc.input_messenger import _process_one
         from brpc_tpu.rpc.protocol import ParsedMessage
 
         trpc, tstr = self._protocols()
         try:
-            if tag == 1:
+            if prebuilt_meta is not None:
+                meta = prebuilt_meta
+                proto = trpc
+            elif tag == 1:
                 meta = rpc_meta_pb2.StreamFrameMeta.FromString(meta_b)
                 proto = tstr
             else:
@@ -476,11 +695,39 @@ class NativeDataplane:
         msg = ParsedMessage(proto, meta, IOBuf(body_b))
         msg.socket = sock
         sock.in_messages += 1
-        sock.in_bytes += len(meta_b) + len(body_b)
+        sock.in_bytes += (len(meta_b) if meta_b else 0) + len(body_b)
         sock.last_active = _time.monotonic()
         cid = proto.claim_cid(msg)
         if cid is not None:
             sock.remove_pending_id(cid)
+            if sock._fast_calls:
+                # big (>=64KB donated) or compressed responses to FAST calls
+                # arrive as full frames — complete the fast record here
+                rec = sock._fast_calls.pop(cid, None)
+                if rec is not None:
+                    m = msg.meta
+                    rec.code = m.response.error_code
+                    rec.text = m.response.error_text
+                    body = msg.body.tobytes()
+                    if m.compress_type:
+                        from brpc_tpu.policy import compress as _compress
+
+                        try:
+                            att = b""
+                            if m.attachment_size:
+                                att = body[len(body) - m.attachment_size:]
+                                body = body[:len(body) - m.attachment_size]
+                            body = _compress.decompress(body, m.compress_type)
+                            body += att
+                            rec.att_size = m.attachment_size
+                        except Exception as e:
+                            rec.code = errors.ERESPONSE
+                            rec.text = f"decompress: {e}"
+                    else:
+                        rec.att_size = m.attachment_size
+                    rec.body = body
+                    rec.finish()
+                    return
         server = sock.owner_server
         if proto.inline_process or cid is not None:
             # stream frames need poll order; RESPONSES are just deserialize +
@@ -555,6 +802,26 @@ class NativeDataplane:
         self._running = False
         self._poller.join(timeout=2)
         self._lib.dp_rt_shutdown(self._rt)
+
+
+# lazy hook into the server-side fast dispatch (import cycle: server
+# machinery imports this module at load time)
+_fp_fn = None
+
+
+def _fast_process_request(item) -> None:
+    global _fp_fn
+    if _fp_fn is None:
+        from brpc_tpu.rpc.server_processing import fast_process_request
+
+        _fp_fn = fast_process_request
+    _fp_fn(item)
+
+
+def on_flusher_thread() -> bool:
+    """True on threads that end every batch with dp_flush_all (the poller
+    and the fast dispatcher) — queued sends are safe there."""
+    return getattr(threading.current_thread(), "brpc_fast_flusher", False)
 
 
 _dataplane: Optional[NativeDataplane] = None

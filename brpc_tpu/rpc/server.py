@@ -158,6 +158,12 @@ class ServerOptions:
     # own handler registries (redis/mongo/thrift/nshead services) bypass pb
     # dispatch entirely and enforce their own admission.
     interceptor: object = None
+    # run user methods INLINE on the native poller for engine-parsed fast
+    # requests (reference default: user code runs in the parsing bthread,
+    # baidu_rpc_protocol.cpp:848). Only safe when no method blocks — a
+    # handler issuing a sync downstream RPC would deadlock the process's
+    # completion loop. Off = fast requests run on a dispatch worker.
+    usercode_inline: bool = False
 
 
 class Server:
@@ -181,6 +187,7 @@ class Server:
         self._native_lid = None         # native dataplane listener id
         self._native_dp = None
         self._native_echoes = []        # (service, method) C++ fast paths
+        self._method_cache = {}         # (service, method) -> MethodEntry
         self._ssl_ctx = None            # built lazily from options.ssl
         self._master_service = None     # catch-all generic service
         self.rpc_dumper = None
@@ -284,30 +291,53 @@ class Server:
             # tpu://host:port/ordinal — TPUC handshakes become native shm
             # tunnels; plain TRPC/HTTP on the same port still works
             self._tpu_ordinal = ep.device_ordinal
+        # engine-parsed EV_REQUEST fast path: only when no option needs the
+        # raw meta per request (auth tokens / interceptor / rpc_dump ride
+        # the full pipeline)
+        fastpath = (self.options.auth is None
+                    and self.options.interceptor is None
+                    and self.rpc_dumper is None)
         self._native_lid, port = dp.listen(self, host, ep.port,
-                                           tpu_ordinal=tpu_ordinal)
+                                           tpu_ordinal=tpu_ordinal,
+                                           fastpath=fastpath)
         self._native_dp = dp
         self._listen_ep = EndPoint.from_tpu(host, ep.device_ordinal,
                                             port=port) if ep.is_tpu() \
             else EndPoint.from_ip_port(host, port)
         self._running = True
         self._logoff = False
-        for svc, method in self._native_echoes:
-            dp.register_echo(self._native_lid, svc, method)
+        for svc, method, max_conc in self._native_echoes:
+            dp.register_echo(self._native_lid, svc, method, max_conc)
         self._schedule_idle_sweep()
         return True
 
-    def register_native_echo(self, service_name: str, method_name: str) -> None:
+    def register_native_echo(self, service_name: str, method_name: str,
+                             max_concurrency: int = 0) -> None:
         """Answer (service, method) entirely inside the C++ engine — the
         rebuild's 'user code in C++' lane (the reference's services ARE
         C++). The handler echoes the request body back (attachment
-        included); auth/limiters/spans do NOT run for these calls, exactly
-        like a reference service that bypasses ServerOptions hooks. Only
-        meaningful with ``native_dataplane=True``."""
-        self._native_echoes.append((service_name, method_name))
+        included) and runs the native request path: admission (ELOGOFF on
+        stop, ``max_concurrency`` limit) and method status (qps/latency/
+        errors, surfaced at /status) live in the engine; Python auth/
+        interceptor hooks do not run (reference MethodStatus semantics,
+        user code in C++). Only meaningful with ``native_dataplane=True``."""
+        self._native_echoes.append((service_name, method_name,
+                                    max_concurrency))
         if self._native_dp is not None and self._native_lid is not None:
             self._native_dp.register_echo(self._native_lid, service_name,
-                                          method_name)
+                                          method_name, max_concurrency)
+
+    def native_method_stats(self):
+        """[(service, method, stats-dict)] for native services (the /status
+        section the engine's counters feed)."""
+        out = []
+        if self._native_dp is None or self._native_lid is None:
+            return out
+        for svc, method, _mc in self._native_echoes:
+            st = self._native_dp.svc_stats(self._native_lid, svc, method)
+            if st is not None:
+                out.append((svc, method, st))
+        return out
 
     def adopt_connection(self, pysock, initial_bytes: bytes = b"",
                          dispatcher=None) -> None:
@@ -337,7 +367,9 @@ class Server:
         """Graceful: reject new requests (ELOGOFF), keep serving in-flight."""
         self._logoff = True
         if self._native_lid is not None:
-            # listener only — in-flight requests finish; join() tears down
+            # listener only — in-flight requests finish; join() tears down.
+            # Native services start answering ELOGOFF like the Python path.
+            self._native_dp.set_listener_logoff(self._native_lid, True)
             self._native_dp.stop_listening(self._native_lid)
         if self._idle_sweep_timer is not None:
             from brpc_tpu.fiber.timer import timer_del

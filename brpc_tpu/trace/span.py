@@ -178,9 +178,18 @@ def start_server_span(meta, service: str, method: str,
                       peer: str = "") -> Optional[Span]:
     """Server span continuing a propagated trace (or rooting a new one
     when the client didn't trace)."""
-    trace_id = meta.request.trace_id if meta is not None else 0
+    return start_server_span_ids(
+        meta.request.trace_id if meta is not None else 0,
+        meta.request.span_id if meta is not None else 0,
+        service, method, peer)
+
+
+def start_server_span_ids(trace_id: int, parent_span_id: int, service: str,
+                          method: str, peer: str = "") -> Optional[Span]:
+    """Same as :func:`start_server_span` from pre-cracked ids (the native
+    fast path delivers trace/span ids without a meta pb)."""
     if trace_id:
-        return Span(trace_id, _gen_id(), meta.request.span_id,
+        return Span(trace_id, _gen_id(), parent_span_id,
                     KIND_SERVER, service, method, peer)
     if not _sampled():
         return None

@@ -1,0 +1,333 @@
+"""Fast-path lane tests: engine-parsed EV_REQUEST/EV_RESPONSE events,
+native request/response packing (dp_call/dp_respond), fast-call records,
+and native-service admission/status (VERDICT r2 #2).
+
+The fast lane must be semantically indistinguishable from the full
+Controller pipeline for plain unary RPCs; these tests pin that contract.
+"""
+
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.proto import echo_pb2
+from brpc_tpu.rpc import (Channel, ChannelOptions, Controller, Server,
+                          ServerOptions, Service, Stub)
+from brpc_tpu.rpc.channel import MethodDescriptor, RpcError
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.native_transport import dataplane_available
+
+pytestmark = pytest.mark.skipif(not dataplane_available(),
+                                reason="native engine unavailable")
+
+SVC = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+
+class EchoImpl(Service):
+    DESCRIPTOR = SVC
+
+    def Echo(self, cntl, request, done):
+        cntl.response_attachment = cntl.request_attachment
+        return echo_pb2.EchoResponse(message=request.message,
+                                     payload=request.payload)
+
+
+def _fast_channel(ep, **kw):
+    kw.setdefault("timeout_ms", 5000)
+    ch = Channel(ChannelOptions(protocol="trpc_std",
+                                native_transport=True, **kw))
+    ch.init(str(ep))
+    return ch
+
+
+@pytest.fixture()
+def native_server():
+    srv = Server(ServerOptions(native_dataplane=True))
+    srv.add_service(EchoImpl())
+    srv.start("127.0.0.1:0")
+    yield srv
+    srv.stop()
+    srv.join()
+
+
+def test_fast_sync_echo(native_server):
+    ch = _fast_channel(native_server.listen_endpoint())
+    stub = Stub(ch, SVC)
+    for i in range(10):
+        r = stub.Echo(echo_pb2.EchoRequest(message=f"m{i}"))
+        assert r.message == f"m{i}"
+
+
+def test_fast_attachment_roundtrip(native_server):
+    ch = _fast_channel(native_server.listen_endpoint())
+    stub = Stub(ch, SVC)
+    cntl = Controller()
+    cntl.request_attachment = b"\x01\x02" * 500
+    stub.Echo(echo_pb2.EchoRequest(message="a"), controller=cntl)
+    assert cntl.response_attachment == b"\x01\x02" * 500
+    assert cntl.latency_us > 0
+
+
+def test_fast_big_response_via_donated_frame(native_server):
+    # >=64KB responses arrive as donated EV_FRAME buffers; the fast record
+    # must still complete through the frame path
+    ch = _fast_channel(native_server.listen_endpoint())
+    stub = Stub(ch, SVC)
+    cntl = Controller()
+    cntl.request_attachment = b"\xee" * (256 << 10)
+    stub.Echo(echo_pb2.EchoRequest(message="big"), controller=cntl)
+    assert cntl.response_attachment == b"\xee" * (256 << 10)
+
+
+def test_fast_unknown_service_and_method(native_server):
+    ch = _fast_channel(native_server.listen_endpoint())
+    md = MethodDescriptor("NoSuchService", "Echo",
+                          echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    with pytest.raises(RpcError) as ei:
+        ch.call_method(md, echo_pb2.EchoRequest(message="x"))
+    assert ei.value.error_code == errors.ENOSERVICE
+    md2 = MethodDescriptor("EchoService", "Nope",
+                           echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    with pytest.raises(RpcError) as ei:
+        ch.call_method(md2, echo_pb2.EchoRequest(message="x"))
+    assert ei.value.error_code == errors.ENOMETHOD
+
+
+def test_fast_async_done(native_server):
+    ch = _fast_channel(native_server.listen_endpoint())
+    stub = Stub(ch, SVC)
+    ev = threading.Event()
+    seen = {}
+
+    def done(cntl):
+        seen["code"] = cntl.error_code
+        seen["resp"] = cntl.response
+        seen["att"] = cntl.response_attachment
+        ev.set()
+
+    stub.Echo(echo_pb2.EchoRequest(message="async"), done=done)
+    assert ev.wait(5)
+    assert seen["code"] == errors.OK
+    assert seen["resp"].message == "async"
+
+
+def test_fast_timeout_held_done(native_server):
+    held = []
+
+    class Holder(Service):
+        DESCRIPTOR = SVC
+
+        def Echo(self, cntl, request, done):
+            held.append(done)  # never respond: client must time out
+            return None
+
+    srv2 = Server(ServerOptions(native_dataplane=True))
+    srv2.add_service(Holder())
+    srv2.start("127.0.0.1:0")
+    try:
+        ch = _fast_channel(srv2.listen_endpoint(), timeout_ms=300)
+        stub = Stub(ch, SVC)
+        t0 = time.monotonic()
+        with pytest.raises(RpcError) as ei:
+            stub.Echo(echo_pb2.EchoRequest(message="never"))
+        assert ei.value.error_code == errors.ERPCTIMEDOUT
+        assert time.monotonic() - t0 < 3.0
+    finally:
+        for d in held:
+            d(None)
+        srv2.stop()
+        srv2.join()
+
+
+def test_fast_async_timeout_swept(native_server):
+    held = []
+
+    class Holder(Service):
+        DESCRIPTOR = SVC
+
+        def Echo(self, cntl, request, done):
+            held.append(done)
+            return None
+
+    srv2 = Server(ServerOptions(native_dataplane=True))
+    srv2.add_service(Holder())
+    srv2.start("127.0.0.1:0")
+    try:
+        ch = _fast_channel(srv2.listen_endpoint(), timeout_ms=200)
+        stub = Stub(ch, SVC)
+        ev = threading.Event()
+        seen = {}
+
+        def done(cntl):
+            seen["code"] = cntl.error_code
+            ev.set()
+
+        stub.Echo(echo_pb2.EchoRequest(message="x"), done=done)
+        # the poller's coarse deadline sweep must fire the timeout
+        assert ev.wait(5)
+        assert seen["code"] == errors.ERPCTIMEDOUT
+    finally:
+        for d in held:
+            d(None)
+        srv2.stop()
+        srv2.join()
+
+
+def test_fast_elogoff_after_stop(native_server):
+    ch = _fast_channel(native_server.listen_endpoint(), max_retry=0)
+    stub = Stub(ch, SVC)
+    stub.Echo(echo_pb2.EchoRequest(message="warm"))
+    native_server.stop()
+    with pytest.raises(RpcError) as ei:
+        stub.Echo(echo_pb2.EchoRequest(message="rejected"))
+    # logoff either rejects at admission or (if teardown already closed
+    # the conn) surfaces as a socket failure
+    assert ei.value.error_code in (errors.ELOGOFF, errors.EFAILEDSOCKET)
+
+
+def test_fast_method_concurrency_limit():
+    release = threading.Event()
+    entered = threading.Event()
+
+    class Slow(Service):
+        DESCRIPTOR = SVC
+
+        def Echo(self, cntl, request, done):
+            entered.set()
+            held_done.append(done)
+            return None  # respond later
+
+    held_done = []
+    srv = Server(ServerOptions(native_dataplane=True))
+    svc = Slow()
+    srv.add_service(svc)
+    svc.find_method("Echo").max_concurrency = 1
+    srv.start("127.0.0.1:0")
+    try:
+        ch = _fast_channel(srv.listen_endpoint(), max_retry=0,
+                           timeout_ms=3000)
+        stub = Stub(ch, SVC)
+        ev = threading.Event()
+        first = {}
+
+        def done1(cntl):
+            first["code"] = cntl.error_code
+            ev.set()
+
+        stub.Echo(echo_pb2.EchoRequest(message="one"), done=done1)
+        assert entered.wait(5)
+        with pytest.raises(RpcError) as ei:
+            stub.Echo(echo_pb2.EchoRequest(message="two"))
+        assert ei.value.error_code == errors.ELIMIT
+        for d in held_done:
+            d(echo_pb2.EchoResponse(message="late"))
+        assert ev.wait(5)
+        assert first["code"] == errors.OK
+        release.set()
+    finally:
+        srv.stop()
+        srv.join()
+
+
+def test_fast_trace_propagation(native_server):
+    # force sampling so the fast path carries trace ids natively
+    from brpc_tpu import flags
+    from brpc_tpu.metrics import collector as _collector
+    from brpc_tpu.trace import span as _span
+
+    _span.reset_for_test()
+    coll = _collector.global_collector()
+    old_rate = coll._fixed_rate
+    coll._fixed_rate = 10 ** 9
+    coll._deny_until = 0.0
+    try:
+        ch = _fast_channel(native_server.listen_endpoint())
+        stub = Stub(ch, SVC)
+        r = stub.Echo(echo_pb2.EchoRequest(message="traced"))
+        assert r.message == "traced"
+        time.sleep(0.2)  # server span lands via its own process... same proc
+        spans = _span.recent_spans(50)
+        kinds = {(s.kind, s.service) for s in spans}
+        # client and server spans of the same trace must both exist
+        client_spans = [s for s in spans if s.kind == _span.KIND_CLIENT
+                        and s.method == "Echo"]
+        server_spans = [s for s in spans if s.kind == _span.KIND_SERVER
+                        and s.method == "Echo"]
+        assert client_spans and server_spans, (kinds, spans)
+        tids = {s.trace_id for s in client_spans}
+        assert any(s.trace_id in tids for s in server_spans)
+    finally:
+        coll._fixed_rate = old_rate
+
+
+def test_slow_path_call_on_fast_conn(native_server):
+    # a full-Controller call (backup_request forces the slow path) on a
+    # fast conn completes through the EV_RESPONSE reconstruct route
+    ch = Channel(ChannelOptions(protocol="trpc_std", timeout_ms=5000,
+                                native_transport=True,
+                                backup_request_ms=60000))
+    ch.init(str(native_server.listen_endpoint()))
+    stub = Stub(ch, SVC)
+    r = stub.Echo(echo_pb2.EchoRequest(message="slowlane"))
+    assert r.message == "slowlane"
+
+
+def test_native_echo_admission_and_stats():
+    srv = Server(ServerOptions(native_dataplane=True))
+    srv.add_service(EchoImpl())
+    srv.start("127.0.0.1:0")
+    srv.register_native_echo("EchoService", "Echo")
+    try:
+        ch = _fast_channel(srv.listen_endpoint(), max_retry=0)
+        stub = Stub(ch, SVC)
+        for i in range(5):
+            r = stub.Echo(echo_pb2.EchoRequest(message=f"n{i}"))
+            assert r.message == f"n{i}"
+        stats = srv.native_method_stats()
+        assert stats, "native method stats missing"
+        _, _, st = stats[0]
+        assert st["requests"] >= 5
+        assert st["errors"] == 0
+        assert st["latency_max_us"] >= 0.0
+        # graceful stop: native admission answers ELOGOFF
+        srv.stop()
+        with pytest.raises(RpcError) as ei:
+            stub.Echo(echo_pb2.EchoRequest(message="x"))
+        assert ei.value.error_code in (errors.ELOGOFF, errors.EFAILEDSOCKET)
+        if ei.value.error_code == errors.ELOGOFF:
+            st2 = srv.native_method_stats()[0][2]
+            assert st2["errors"] >= 1
+    finally:
+        srv.stop()
+        srv.join()
+
+
+def test_fast_usercode_inline_server():
+    srv = Server(ServerOptions(native_dataplane=True, usercode_inline=True))
+    srv.add_service(EchoImpl())
+    srv.start("127.0.0.1:0")
+    try:
+        ch = _fast_channel(srv.listen_endpoint())
+        stub = Stub(ch, SVC)
+        for i in range(20):
+            assert stub.Echo(echo_pb2.EchoRequest(message=str(i))).message \
+                == str(i)
+    finally:
+        srv.stop()
+        srv.join()
+
+
+def test_fast_retry_after_server_restart():
+    srv = Server(ServerOptions(native_dataplane=True))
+    srv.add_service(EchoImpl())
+    srv.start("127.0.0.1:0")
+    ep = srv.listen_endpoint()
+    ch = _fast_channel(ep, timeout_ms=2000)
+    stub = Stub(ch, SVC)
+    assert stub.Echo(echo_pb2.EchoRequest(message="a")).message == "a"
+    srv.stop()
+    srv.join()
+    # server gone: calls fail fast (retry budget burns on dead conns)
+    with pytest.raises(RpcError):
+        stub.Echo(echo_pb2.EchoRequest(message="b"))

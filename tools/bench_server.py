@@ -36,8 +36,13 @@ def main(argv=None):
                     help="serve through the C++ dataplane engine")
     ap.add_argument("--native_echo", action="store_true",
                     help="answer EchoService.Echo entirely in C++")
+    ap.add_argument("--inline", action="store_true",
+                    help="run user methods inline on the native poller "
+                         "(the reference's usercode-in-parsing-bthread "
+                         "default; safe for non-blocking handlers)")
     args = ap.parse_args(argv)
-    server = Server(ServerOptions(native_dataplane=args.native))
+    server = Server(ServerOptions(native_dataplane=args.native,
+                                  usercode_inline=args.inline))
     server.add_service(EchoServiceImpl())
     server.start(args.listen)
     if args.native_echo:
