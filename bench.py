@@ -236,9 +236,10 @@ def bench_native_tpu_lane():
         print("# native tpu:// tunnel sweep (shm block pools, C++ both "
               "ends):", file=sys.stderr)
         # configs picked for a single shared core: extra conns only add
-        # self-contention; pipeline depth does the overlapping
+        # self-contention; pipeline depth does the overlapping (the
+        # negotiated window lets 16MB messages pipeline too)
         for size, conns, depth in [(4096, 4, 4), (65536, 1, 4),
-                                   (1 << 20, 1, 2), (16 << 20, 1, 1)]:
+                                   (1 << 20, 1, 2), (16 << 20, 1, 2)]:
             r = bench_echo_native(host, port, conns=conns, depth=depth,
                                   payload=size, duration_ms=dur, tpu=True)
             print(f"#   {size:>9}B x{conns}conns x{depth}deep: "

@@ -148,6 +148,12 @@ def load_dataplane() -> Optional[ctypes.CDLL]:
                                        ctypes.c_int, ctypes.c_int,
                                        ctypes.c_int,
                                        ctypes.POINTER(ctypes.c_int)]
+        lib.dp_connect_tpu2.restype = ctypes.c_uint64
+        lib.dp_connect_tpu2.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_int, ctypes.c_int,
+                                        ctypes.c_int, ctypes.c_uint32,
+                                        ctypes.c_uint32,
+                                        ctypes.POINTER(ctypes.c_int)]
         lib.dp_listener_set_tpu.restype = ctypes.c_int
         lib.dp_listener_set_tpu.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                             ctypes.c_int]
@@ -201,6 +207,9 @@ def load_dataplane() -> Optional[ctypes.CDLL]:
             ctypes.c_uint64, ctypes.c_int]
         lib.dp_flush_all.restype = ctypes.c_int
         lib.dp_flush_all.argtypes = [ctypes.c_void_p]
+        lib.dp_tpu_ack.restype = ctypes.c_int
+        lib.dp_tpu_ack.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                   ctypes.c_char_p, ctypes.c_uint64]
         lib.dp_svc_set_limit.restype = ctypes.c_int
         lib.dp_svc_set_limit.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                          ctypes.c_char_p, ctypes.c_char_p,

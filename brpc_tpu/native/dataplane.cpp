@@ -93,6 +93,12 @@ enum {
   // baidu_rpc_protocol.cpp:565; this is our analog for Python services)
   EV_REQUEST = 5,   // aux: cid; meta: ReqLite+svc+method; body: payload+att
   EV_RESPONSE = 6,  // aux: cid; tag: error_code; meta: RespLite+error_text
+  // zero-copy tunnel response: the payload stays in the registered pool
+  // blocks (reference rdma zero-copy recv: blocks attach straight to the
+  // IOBuf, block_pool.cpp). meta: RespLite + u32 nsegs + nsegs*(u64 ptr,
+  // u64 len) + u32 ack_len + ack body; the consumer reads the segments,
+  // then MUST dp_tpu_ack the ack blob to return the peer's credits.
+  EV_RESPONSE_ZC = 7,
 };
 
 // packed structs riding EV_REQUEST / EV_RESPONSE meta buffers (same-machine
@@ -458,6 +464,14 @@ struct RBuf {
     }
     return data + size;
   }
+  // grow once to `total` — doubling reallocs memcpy an MB-scale frame
+  // several times over on the shared core
+  void reserve(size_t total) {
+    if (total > cap) {
+      data = static_cast<uint8_t*>(realloc(data, total));
+      cap = total;
+    }
+  }
 };
 
 // Tunnel state for a TPUC conn (reference RdmaEndpoint: registered block
@@ -497,6 +511,11 @@ struct TpuState {
     uint8_t* base = nullptr;     // free() after send (stolen stream buffer)
     const uint8_t* body = nullptr;
     uint64_t blen = 0;
+    // zero-copy echo: body segments referencing OUR pool blocks; `ack`
+    // (the TFT_ACK body returning those blocks) is sent AFTER the
+    // response bytes leave — the peer must not reuse them mid-read
+    std::vector<std::pair<const uint8_t*, uint64_t>> segs;
+    std::string ack;
   };
   std::mutex qmu;
   std::condition_variable qcv;
@@ -551,6 +570,8 @@ struct Conn {
 
   std::atomic<uint64_t> in_bytes{0}, out_bytes{0};
   std::atomic<uint64_t> in_msgs{0}, out_msgs{0};
+  // zero-copy events referencing this conn's pool still in consumer hands
+  std::atomic<int> zc_outstanding{0};
 };
 
 struct Listener {
@@ -624,6 +645,12 @@ struct Runtime {
   // conns with queued dp_respond/dp_call packets (dp_flush_all drains)
   std::mutex fmu;
   std::vector<std::shared_ptr<Conn>> flush_list;
+
+  // pools of failed conns with zero-copy events still out: the mapping
+  // must outlive the consumer's reads (freed at shutdown; bounded by
+  // conns that die with events in flight)
+  std::mutex gmu;
+  std::vector<std::unique_ptr<TpuState>> tpu_graveyard;
 };
 
 int64_t mono_ns() {
@@ -724,6 +751,20 @@ void arm(Runtime* rt, Conn* c, bool out) {
 }
 
 // ------------------------------------------------------------- tpu tunnel
+// Clamp a requested pool geometry to sane bounds (reference negotiates
+// queue geometry in its handshake, rdma_endpoint.cpp:127-130; a peer must
+// not be able to demand an absurd registration)
+void tpu_clamp_geometry(uint32_t* bs, uint32_t* bc) {
+  if (*bs == 0) *bs = kTpuBlockSize;
+  if (*bc == 0) *bc = kTpuBlockCount;
+  if (*bs < (16u << 10)) *bs = 16u << 10;
+  if (*bs > (4u << 20)) *bs = 4u << 20;
+  *bs = (*bs + 4095u) & ~4095u;  // page-align
+  if (*bc < 4) *bc = 4;
+  if (*bc > 512) *bc = 512;
+  while (uint64_t(*bs) * *bc > (512ull << 20) && *bc > 4) *bc /= 2;
+}
+
 bool tpu_create_pool(TpuState* t) {
   char name[64];
   static std::atomic<uint32_t> seq{0};
@@ -908,6 +949,12 @@ void conn_fail(Runtime* rt, const std::shared_ptr<Conn>& c, int err_class,
     c->fd = -1;
   }
   tpu_teardown(c.get());
+  if (c->tpu && c->zc_outstanding.load() > 0) {
+    // a consumer still holds pointers into the pool — keep the mapping
+    // alive past the conn (reclaimed at runtime shutdown)
+    std::lock_guard<std::mutex> glk(rt->gmu);
+    rt->tpu_graveyard.push_back(std::move(c->tpu));
+  }
   emit_failed(rt, c.get(), err_class, reason);
   std::lock_guard<std::mutex> lk(rt->cmu);
   rt->conns.erase(c->id);
@@ -1090,6 +1137,149 @@ Runtime::EchoSvc* echo_match(Runtime* rt, int lid, const MetaLite& m) {
 constexpr int32_t kElogoff = 1011;
 constexpr int32_t kElimit = 1012;
 
+// Native request-path admission + method status (reference
+// MethodStatus::OnRequested, baidu_rpc_protocol.cpp:661-712).
+struct EchoAdmit {
+  Runtime::EchoSvc* svc = nullptr;
+  int64_t t0 = 0;
+  int32_t ecode = 0;
+  const char* etext = "";
+  bool counted = false;
+};
+
+// False: not a registered native service (frame goes to Python). True:
+// admission ran; a->ecode holds the rejection (0 = admitted).
+bool echo_admit(Runtime* rt, Conn* c, const MetaLite& m, EchoAdmit* a) {
+  if (!c->is_server || !m.has_request || m.has_response || m.compress_type ||
+      m.checksum || m.has_stream_settings || m.has_auth) {
+    return false;
+  }
+  a->svc = echo_match(rt, c->listener_id, m);
+  if (a->svc == nullptr) return false;
+  a->t0 = mono_ns();
+  a->svc->requests.fetch_add(1, std::memory_order_relaxed);
+  if (a->svc->logoff.load(std::memory_order_relaxed)) {
+    a->ecode = kElogoff;
+    a->etext = "server is stopping";
+  } else if (a->svc->max_concurrency) {
+    int32_t cur = a->svc->concurrency.fetch_add(
+                      1, std::memory_order_relaxed) + 1;
+    if (cur > a->svc->max_concurrency) {
+      a->svc->concurrency.fetch_sub(1, std::memory_order_relaxed);
+      a->ecode = kElimit;
+      a->etext = "method concurrency limit";
+    } else {
+      a->counted = true;
+    }
+  }
+  return true;
+}
+
+void echo_settle(EchoAdmit* a) {
+  if (a->counted) {
+    a->svc->concurrency.fetch_sub(1, std::memory_order_relaxed);
+  }
+  if (a->ecode) a->svc->errors.fetch_add(1, std::memory_order_relaxed);
+  uint64_t dt = uint64_t(mono_ns() - a->t0);
+  a->svc->latency_sum_ns.fetch_add(dt, std::memory_order_relaxed);
+  uint64_t prev = a->svc->latency_max_ns.load(std::memory_order_relaxed);
+  while (dt > prev &&
+         !a->svc->latency_max_ns.compare_exchange_weak(prev, dt)) {
+  }
+}
+
+// Queue a tunnel response on the per-conn sender worker (NEVER send from
+// the loop thread: tpu_send_packet may wait for credit ACKs that only the
+// loop can deliver). Spawns the worker on first use; ts is captured by
+// value — conn_fail may move the TpuState into the graveyard, but the
+// object itself stays alive.
+void tpu_enqueue_resp(Runtime* rt, const std::shared_ptr<Conn>& c,
+                      TpuState* ts, TpuState::Resp&& resp) {
+  {
+    std::lock_guard<std::mutex> lk(ts->qmu);
+    ts->respq.push_back(std::move(resp));
+    if (!ts->sender_running) {
+      ts->sender_running = true;
+      auto done = std::make_shared<std::atomic<bool>>(false);
+      std::thread thr([rt, c, ts, done] {
+        for (;;) {
+          TpuState::Resp item;
+          {
+            std::unique_lock<std::mutex> qlk(ts->qmu);
+            ts->qcv.wait(qlk, [ts, &c] {
+              return !ts->respq.empty() || ts->q_closed ||
+                     c->failed.load();
+            });
+            if (ts->respq.empty()) {  // closed/failed: drain done
+              done->store(true);
+              return;
+            }
+            item = std::move(ts->respq.front());
+            ts->respq.pop_front();
+          }
+          int rc;
+          if (!item.segs.empty()) {
+            // zero-copy echo: head + pool-block segments, then the ACK
+            // returning those blocks (never before — the peer may reuse
+            // them the instant the credit lands)
+            std::vector<const uint8_t*> bb(item.segs.size() + 1);
+            std::vector<uint64_t> ll(item.segs.size() + 1);
+            bb[0] = reinterpret_cast<const uint8_t*>(item.head.data());
+            ll[0] = item.head.size();
+            for (size_t si = 0; si < item.segs.size(); si++) {
+              bb[si + 1] = item.segs[si].first;
+              ll[si + 1] = item.segs[si].second;
+            }
+            rc = tpu_send_packet(rt, c, bb.data(), ll.data(),
+                                 int(bb.size()));
+          } else {
+            const uint8_t* bb[2] = {
+                reinterpret_cast<const uint8_t*>(item.head.data()),
+                item.body};
+            const uint64_t ll[2] = {item.head.size(), item.blen};
+            rc = tpu_send_packet(rt, c, bb, ll, 2);
+          }
+          if (rc == DPE_OK && !item.ack.empty()) {
+            // the donated blocks go back on EVERY outcome that keeps the
+            // conn alive — an admission-rejected request (segs empty, no
+            // body echoed) must still return the peer's credits
+            const uint8_t* ab[1] = {
+                reinterpret_cast<const uint8_t*>(item.ack.data())};
+            const uint64_t al[1] = {item.ack.size()};
+            rc = tpu_ctrl_send(rt, c, TFT_ACK, ab, al, 1);
+          }
+          free(item.base);
+          if (rc != DPE_OK) {
+            if (rt->running.load()) {
+              loop_submit(rt, c->loop, [rt, c] {
+                conn_fail(rt, c, DPE_IO,
+                          "native service response undeliverable");
+              });
+            }
+            done->store(true);
+            return;
+          }
+        }
+      });
+      register_sender(rt, std::move(thr), done);
+    }
+  }
+  ts->qcv.notify_one();
+}
+
+std::string echo_response_head(const MetaLite& m, const EchoAdmit& a,
+                               uint64_t body_len) {
+  std::string meta = a.ecode
+      ? build_response_meta(m.correlation_id, m.attempt_version, a.ecode,
+                            a.etext, strlen(a.etext), 0)
+      : build_echo_response_meta(m);
+  std::string head;
+  head.reserve(kHeaderSize + meta.size());
+  put_trpc_header(&head, meta.size(), a.ecode ? 0 : body_len);
+  head.append(meta);
+  return head;
+}
+
 // Answer a registered echo request natively, running the full native
 // request path: admission (logoff, per-method concurrency limit) +
 // method status (qps/latency/errors) + user code (echo) + response pack.
@@ -1097,53 +1287,13 @@ constexpr int32_t kElimit = 1012;
 bool try_native_echo(Runtime* rt, const std::shared_ptr<Conn>& c,
                      const MetaLite& m, const uint8_t* body,
                      uint64_t body_len, RBuf* whole_buf, ParseBatch* batch) {
-  if (!c->is_server || !m.has_request || m.has_response || m.compress_type ||
-      m.checksum || m.has_stream_settings || m.has_auth) {
-    return false;
-  }
   if (m.attachment_size > body_len) return false;
-  Runtime::EchoSvc* svc = echo_match(rt, c->listener_id, m);
-  if (svc == nullptr) return false;
-  int64_t t0 = mono_ns();
-  svc->requests.fetch_add(1, std::memory_order_relaxed);
-  int32_t ecode = 0;
-  const char* etext = "";
-  bool counted = false;
-  if (svc->logoff.load(std::memory_order_relaxed)) {
-    ecode = kElogoff;
-    etext = "server is stopping";
-  } else if (svc->max_concurrency) {
-    int32_t cur = svc->concurrency.fetch_add(
-                      1, std::memory_order_relaxed) + 1;
-    if (cur > svc->max_concurrency) {
-      svc->concurrency.fetch_sub(1, std::memory_order_relaxed);
-      ecode = kElimit;
-      etext = "method concurrency limit";
-    } else {
-      counted = true;
-    }
-  }
-  auto settle = [&](bool is_error) {
-    if (counted) svc->concurrency.fetch_sub(1, std::memory_order_relaxed);
-    if (is_error) svc->errors.fetch_add(1, std::memory_order_relaxed);
-    uint64_t dt = uint64_t(mono_ns() - t0);
-    svc->latency_sum_ns.fetch_add(dt, std::memory_order_relaxed);
-    uint64_t prev = svc->latency_max_ns.load(std::memory_order_relaxed);
-    while (dt > prev &&
-           !svc->latency_max_ns.compare_exchange_weak(prev, dt)) {
-    }
-  };
+  EchoAdmit admit;
+  if (!echo_admit(rt, c.get(), m, &admit)) return false;
+  int32_t ecode = admit.ecode;
+  auto settle = [&](bool) { echo_settle(&admit); };
   if (ecode) body_len = 0;  // admission rejections carry no body
-  std::string head;
-  {
-    std::string meta = ecode
-        ? build_response_meta(m.correlation_id, m.attempt_version, ecode,
-                              etext, strlen(etext), 0)
-        : build_echo_response_meta(m);
-    head.reserve(kHeaderSize + meta.size());
-    put_trpc_header(&head, meta.size(), body_len);
-    head.append(meta);
-  }
+  std::string head = echo_response_head(m, admit, body_len);
   // body still points into the conn's read buffer: conn_writev either puts
   // it on the wire or copies the remainder before returning, so the
   // zero-assembly reference is safe
@@ -1172,51 +1322,7 @@ bool try_native_echo(Runtime* rt, const std::shared_ptr<Conn>& c,
       resp.body = resp.base;
       resp.blen = body_len;
     }
-    {
-      std::lock_guard<std::mutex> lk(t->qmu);
-      t->respq.push_back(std::move(resp));
-      if (!t->sender_running) {
-        t->sender_running = true;
-        auto done = std::make_shared<std::atomic<bool>>(false);
-        std::thread thr([rt, c, done] {
-          TpuState* ts = c->tpu.get();
-          for (;;) {
-            TpuState::Resp item;
-            {
-              std::unique_lock<std::mutex> qlk(ts->qmu);
-              ts->qcv.wait(qlk, [ts, &c] {
-                return !ts->respq.empty() || ts->q_closed ||
-                       c->failed.load();
-              });
-              if (ts->respq.empty()) {  // closed/failed: drain done
-                done->store(true);
-                return;
-              }
-              item = std::move(ts->respq.front());
-              ts->respq.pop_front();
-            }
-            const uint8_t* bb[2] = {
-                reinterpret_cast<const uint8_t*>(item.head.data()),
-                item.body};
-            const uint64_t ll[2] = {item.head.size(), item.blen};
-            int rc = tpu_send_packet(rt, c, bb, ll, 2);
-            free(item.base);
-            if (rc != DPE_OK) {
-              if (rt->running.load()) {
-                loop_submit(rt, c->loop, [rt, c] {
-                  conn_fail(rt, c, DPE_IO,
-                            "native service response undeliverable");
-                });
-              }
-              done->store(true);
-              return;
-            }
-          }
-        });
-        register_sender(rt, std::move(thr), done);
-      }
-    }
-    t->qcv.notify_one();
+    tpu_enqueue_resp(rt, c, t, std::move(resp));
     settle(ecode != 0);
     return true;
   }
@@ -1230,6 +1336,124 @@ bool try_native_echo(Runtime* rt, const std::shared_ptr<Conn>& c,
   batch->nresp++;
   settle(ecode != 0);
   return true;
+}
+
+// Zero-copy consumption of one DATA frame whose pool blocks hold exactly
+// one complete TRPC frame (the common bulk-transfer shape: one message
+// per DATA frame once the window is negotiated). Two routes skip the
+// stream-reassembly copy entirely (reference rdma zero-copy recv —
+// blocks attach straight to the IOBuf, block_pool.cpp):
+//   - native echo: respond straight FROM the blocks, ACK after the send
+//   - client response on a fast conn: EV_RESPONSE_ZC hands the consumer
+//     segment views + the ACK blob (dp_tpu_ack returns the credits)
+// Returns true when fully handled; false -> caller takes the copy path.
+bool tpu_try_zero_copy(Runtime* rt, const std::shared_ptr<Conn>& c,
+                       TpuState* t, const uint8_t* body, uint32_t nsegs) {
+  struct Seg {
+    const uint8_t* p;
+    uint32_t len;
+    uint32_t idx;
+  };
+  if (nsegs > 64) return false;
+  Seg segs[64];
+  uint64_t total = 0;
+  const uint8_t* sp = body + 8;
+  for (uint32_t i = 0; i < nsegs; i++) {
+    uint32_t idx = ntohl(*reinterpret_cast<const uint32_t*>(sp + i * 8));
+    uint32_t ln = ntohl(*reinterpret_cast<const uint32_t*>(sp + i * 8 + 4));
+    if (idx >= t->bc || ln > t->bs || ln == 0) return false;
+    segs[i] = {t->pool + size_t(idx) * t->bs, ln, idx};
+    total += ln;
+  }
+  if (segs[0].len < kHeaderSize) return false;
+  const uint8_t* h = segs[0].p;
+  if (memcmp(h, "TRPC", 4) != 0) return false;  // TSTR: copy path
+  uint64_t meta_size = ntohl(*reinterpret_cast<const uint32_t*>(h + 4));
+  uint64_t body_size = ntohl(*reinterpret_cast<const uint32_t*>(h + 8));
+  if (kHeaderSize + meta_size + body_size != total) return false;
+  if (kHeaderSize + meta_size > segs[0].len) return false;  // meta split
+  if (meta_size + body_size > rt->max_body) return false;
+  MetaLite m;
+  if (!parse_meta_lite(h + kHeaderSize, h + kHeaderSize + meta_size, &m)) {
+    return false;  // copy path surfaces the protocol error
+  }
+  if (m.attachment_size > body_size) return false;
+  // payload views: bytes after header+meta, spanning the blocks
+  std::vector<std::pair<const uint8_t*, uint64_t>> views;
+  uint64_t skip = kHeaderSize + meta_size;
+  for (uint32_t i = 0; i < nsegs; i++) {
+    if (skip >= segs[i].len) {
+      skip -= segs[i].len;
+      continue;
+    }
+    views.emplace_back(segs[i].p + skip, uint64_t(segs[i].len) - skip);
+    skip = 0;
+  }
+  // the ACK returning exactly these blocks
+  std::string ack;
+  ack.resize(4 + size_t(nsegs) * 4);
+  uint32_t n_be = htonl(nsegs);
+  memcpy(&ack[0], &n_be, 4);
+  for (uint32_t i = 0; i < nsegs; i++) {
+    uint32_t idx_be = htonl(segs[i].idx);
+    memcpy(&ack[4 + size_t(i) * 4], &idx_be, 4);
+  }
+  // route 1: native echo — reply straight from the blocks
+  EchoAdmit admit;
+  if (echo_admit(rt, c.get(), m, &admit)) {
+    c->in_msgs.fetch_add(1, std::memory_order_relaxed);
+    TpuState::Resp resp;
+    resp.head = echo_response_head(m, admit, body_size);
+    if (!admit.ecode) resp.segs = std::move(views);
+    resp.ack = std::move(ack);
+    tpu_enqueue_resp(rt, c, t, std::move(resp));
+    echo_settle(&admit);
+    return true;
+  }
+  // route 2: client-side response on a fast conn — deliver views + ack
+  if (!c->is_server && c->py_fast.load(std::memory_order_relaxed) &&
+      m.has_response && !m.has_request && !m.compress_type && !m.checksum &&
+      !m.has_stream_settings) {
+    c->in_msgs.fetch_add(1, std::memory_order_relaxed);
+    size_t et = m.resp_error_text.size();
+    size_t need = sizeof(RespLite) + 4 + views.size() * 16 + 4 +
+                  ack.size() + et;
+    uint8_t* blk = static_cast<uint8_t*>(malloc(need ? need : 1));
+    RespLite rl{};
+    rl.attempt = m.attempt_version;
+    rl.att_size = m.attachment_size;
+    memcpy(blk, &rl, sizeof(rl));
+    uint8_t* w = blk + sizeof(rl);
+    uint32_t nv = uint32_t(views.size());
+    memcpy(w, &nv, 4);
+    w += 4;
+    for (auto& v : views) {
+      uint64_t p = reinterpret_cast<uint64_t>(v.first);
+      memcpy(w, &p, 8);
+      memcpy(w + 8, &v.second, 8);
+      w += 16;
+    }
+    uint32_t alen = uint32_t(ack.size());
+    memcpy(w, &alen, 4);
+    w += 4;
+    memcpy(w, ack.data(), ack.size());
+    w += ack.size();
+    memcpy(w, m.resp_error_text.data(), et);
+    DpEvent ev{};
+    ev.kind = EV_RESPONSE_ZC;
+    ev.tag = int32_t(m.resp_error_code);
+    ev.conn_id = c->id;
+    ev.aux = int64_t(m.correlation_id);
+    ev.base = blk;
+    ev.meta = blk;
+    ev.meta_len = need;
+    ev.body = nullptr;
+    ev.body_len = body_size;  // informational: total payload bytes
+    c->zc_outstanding.fetch_add(1, std::memory_order_relaxed);
+    push_event(rt, ev);
+    return true;
+  }
+  return false;  // anything else: the copy path handles it
 }
 
 // Detach: hand the fd + buffered bytes to Python (non-TRPC protocol on a
@@ -1494,11 +1718,34 @@ void tpu_parse(Runtime* rt, const std::shared_ptr<Conn>& c) {
           conn_fail(rt, c, DPE_PROTOCOL, "bad DATA frame");
           return;
         }
+        if (inline_len == 0 && nsegs > 0 && c->sbuf.size == c->spos &&
+            t != nullptr && t->pool != nullptr &&
+            tpu_try_zero_copy(rt, c, t, body, nsegs)) {
+          if (c->failed.load()) return;
+          c->rpos += kTpuHdrSize + blen;
+          continue;  // consumed without touching the stream buffer
+        }
         if (inline_len) {
           memcpy(c->sbuf.tail(inline_len), body + 8, inline_len);
           c->sbuf.size += inline_len;
         }
         if (nsegs) {
+          // presize the reassembled stream to the frame being built: the
+          // stream head names its total length (TRPC/TSTR header)
+          size_t shave = c->sbuf.size - c->spos;
+          if (shave >= kHeaderSize) {
+            const uint8_t* sp = c->sbuf.data + c->spos;
+            if (!memcmp(sp, "TRPC", 4) || !memcmp(sp, "TSTR", 4)) {
+              uint64_t ftotal = kHeaderSize +
+                  uint64_t(ntohl(*reinterpret_cast<const uint32_t*>(
+                      sp + 4))) +
+                  uint64_t(ntohl(*reinterpret_cast<const uint32_t*>(
+                      sp + 8)));
+              if (ftotal <= rt->max_body + kHeaderSize) {
+                c->sbuf.reserve(c->spos + ftotal);
+              }
+            }
+          }
           // copy the peer-written registered blocks into the stream, then
           // return the credits (reference explicit-ACK sliding window)
           std::string ack;
@@ -1661,6 +1908,14 @@ void tpu_handle_hello(Runtime* rt, const std::shared_ptr<Conn>& c,
   json_int(body, "bs", &bs);
   json_int(body, "bc", &bc);
   json_int(body, "ordinal", &requested);
+  // mirror the dialer's geometry for OUR receive pool (window negotiation:
+  // a bulk-transfer client gets a bulk-sized window both ways)
+  if (bs > 0 && bc > 0) {
+    uint32_t mbs = uint32_t(bs), mbc = uint32_t(bc);
+    tpu_clamp_geometry(&mbs, &mbc);
+    t->bs = mbs;
+    t->bc = mbc;
+  }
   if (t->ordinal >= 0 && requested != t->ordinal) {
     // refuse a dial addressed to a device this server does not front
     char err[160];
@@ -2161,6 +2416,11 @@ void dp_rt_shutdown(void* h) {
     rt->events.clear();
     rt->ecv.notify_all();
   }
+  {
+    // consumers are gone: zero-copy mappings kept for them can go too
+    std::lock_guard<std::mutex> lk(rt->gmu);
+    rt->tpu_graveyard.clear();
+  }
   for (auto& l : rt->loops) {
     close(l->epfd);
     close(l->evfd);
@@ -2369,8 +2629,11 @@ void dp_conn_close(void* h, uint64_t conn_id);
 
 // Dial a tpu:// endpoint natively: TCP bootstrap + TPUC handshake + shm
 // pools, entirely in the engine (reference RdmaEndpoint AppConnect).
-uint64_t dp_connect_tpu(void* h, const char* host, int port, int ordinal,
-                        int timeout_ms, int* err_out) {
+// bs/bc request the tunnel window geometry (0 = defaults); the server
+// mirrors them for its own receive pool, so bulk dials get bulk windows.
+uint64_t dp_connect_tpu2(void* h, const char* host, int port, int ordinal,
+                         int timeout_ms, uint32_t bs, uint32_t bc,
+                         int* err_out) {
   auto* rt = static_cast<Runtime*>(h);
   uint64_t cid = dp_connect(h, host, port, timeout_ms, err_out);
   if (!cid) return 0;
@@ -2386,6 +2649,9 @@ uint64_t dp_connect_tpu(void* h, const char* host, int port, int ordinal,
   }
   auto* t = new TpuState();
   t->ordinal = ordinal;
+  tpu_clamp_geometry(&bs, &bc);
+  t->bs = bs;
+  t->bc = bc;
   c->tpu.reset(t);
   c->tpu_mode = 1;  // published before any byte can arrive: the peer only
                     // speaks after our HELLO below
@@ -2420,6 +2686,11 @@ uint64_t dp_connect_tpu(void* h, const char* host, int port, int ordinal,
     }
   }
   return cid;
+}
+
+uint64_t dp_connect_tpu(void* h, const char* host, int port, int ordinal,
+                        int timeout_ms, int* err_out) {
+  return dp_connect_tpu2(h, host, port, ordinal, timeout_ms, 0, 0, err_out);
 }
 
 int dp_send(void* h, uint64_t conn_id, const uint8_t* data, uint64_t len) {
@@ -2543,6 +2814,24 @@ int dp_call(void* h, uint64_t conn_id, const char* svc, uint64_t svc_len,
   return conn_writev(rt, c, bufs, lens, nseg);
 }
 
+// Return the pool blocks named by an EV_RESPONSE_ZC ack blob to the peer
+// (the consumer has finished reading the zero-copy segments).
+int dp_tpu_ack(void* h, uint64_t conn_id, const uint8_t* ack, uint64_t len) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::shared_ptr<Conn> c;
+  {
+    std::lock_guard<std::mutex> lk(rt->cmu);
+    auto it = rt->conns.find(conn_id);
+    if (it != rt->conns.end()) c = it->second;
+  }
+  if (!c) return DPE_NOTFOUND;  // conn died; its pool sits in the graveyard
+  c->zc_outstanding.fetch_sub(1, std::memory_order_relaxed);
+  if (c->failed.load()) return DPE_IO;
+  const uint8_t* b[1] = {ack};
+  const uint64_t l[1] = {len};
+  return tpu_ctrl_send(rt, c, TFT_ACK, b, l, 1);
+}
+
 // Drain every conn with queued packets (call once per answered poll batch).
 int dp_flush_all(void* h) {
   auto* rt = static_cast<Runtime*>(h);
@@ -2634,11 +2923,18 @@ int dp_bench_echo2(const char* host, int port, int use_tpu, int nconns,
     reqmeta_tail.append(rm);
   }
   std::string body(size_t(payload_len), '\xab');
+  // bulk payloads dial with a bulk window: ~8 messages in flight
+  // (negotiated geometry; the server mirrors it)
+  uint32_t want_bs = 0, want_bc = 0;
+  if (use_tpu && payload_len > (256u << 10)) {
+    want_bs = uint32_t(std::min<uint64_t>(4u << 20, payload_len / 8));
+    want_bc = 64;
+  }
   std::vector<uint64_t> conns;
   for (int i = 0; i < nconns; i++) {
     int err = 0;
     uint64_t cid = use_tpu
-        ? dp_connect_tpu(h, host, port, 0, 5000, &err)
+        ? dp_connect_tpu2(h, host, port, 0, 5000, want_bs, want_bc, &err)
         : dp_connect(h, host, port, 3000, &err);
     if (!cid) {
       dp_rt_shutdown(h);
@@ -2697,6 +2993,26 @@ int dp_bench_echo2(const char* host, int port, int use_tpu, int nconns,
       uint64_t cid = 0;
       if (ev.kind == EV_RESPONSE) {
         cid = uint64_t(ev.aux);
+      } else if (ev.kind == EV_RESPONSE_ZC) {
+        // zero-copy completion: touch the payload views (they live in OUR
+        // registered pool — that IS the receive), then return the credits
+        cid = uint64_t(ev.aux);
+        const uint8_t* mp = static_cast<const uint8_t*>(ev.meta);
+        uint32_t nv;
+        memcpy(&nv, mp + sizeof(RespLite), 4);
+        const uint8_t* w = mp + sizeof(RespLite) + 4;
+        volatile uint8_t sink = 0;
+        for (uint32_t v = 0; v < nv; v++) {
+          uint64_t p, ln;
+          memcpy(&p, w, 8);
+          memcpy(&ln, w + 8, 8);
+          if (ln) sink ^= *reinterpret_cast<const uint8_t*>(p);
+          w += 16;
+        }
+        (void)sink;
+        uint32_t alen;
+        memcpy(&alen, w, 4);
+        dp_tpu_ack(h, ev.conn_id, w + 4, alen);
       } else if (ev.kind == EV_FRAME) {
         // big frames (>=64KB) still arrive as donated EV_FRAME buffers
         MetaLite m;

@@ -280,6 +280,10 @@ class Channel:
                                   cntl, done, span)
             issued = call.issue()
             if issued is None:
+                if cntl is not None:
+                    # the ctor planted its join event on the caller's
+                    # controller — the full pipeline must join by call id
+                    cntl._fast_join_event = None
                 if cntl is None and span is not None:
                     cntl = Controller()
                 if cntl is not None:

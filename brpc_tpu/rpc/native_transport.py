@@ -49,8 +49,9 @@ EV_FRAME = 1
 EV_FAILED = 2
 EV_ACCEPTED = 3
 EV_DETACHED = 4
-EV_REQUEST = 5   # engine-parsed unary request (ReqLite struct + body)
-EV_RESPONSE = 6  # engine-parsed unary response (RespLite struct + body)
+EV_REQUEST = 5      # engine-parsed unary request (ReqLite struct + body)
+EV_RESPONSE = 6     # engine-parsed unary response (RespLite struct + body)
+EV_RESPONSE_ZC = 7  # zero-copy response: pool-block views + ack blob
 
 # ReqLite / RespLite (dataplane.cpp mirrors, host endianness)
 _REQ_STRUCT = struct.Struct("<QQQqqqiHH")  # cid,att_v,att,log,trace,span,to,sl,ml
@@ -417,15 +418,18 @@ class NativeDataplane:
         self._lib.dp_conn_set_fastpath(self._rt, conn, 1)
         return sock
 
-    def connect_tpu(self, ep: EndPoint,
-                    timeout_ms: int = 3000) -> NativeSocket:
+    def connect_tpu(self, ep: EndPoint, timeout_ms: int = 3000,
+                    block_size: int = 0,
+                    block_count: int = 0) -> NativeSocket:
         """Dial a tpu:// endpoint through the engine: TCP bootstrap + TPUC
         handshake + shm block pools, all native (the RDMA-analog lane of
-        tpu/transport.py with the data path in C++)."""
+        tpu/transport.py with the data path in C++). block_size/count
+        request the window geometry; the server mirrors it (0 = defaults)."""
         err = ctypes.c_int(0)
-        conn = self._lib.dp_connect_tpu(
+        conn = self._lib.dp_connect_tpu2(
             self._rt, (ep.host or "127.0.0.1").encode(), ep.port,
-            max(ep.device_ordinal, 0), timeout_ms, ctypes.byref(err))
+            max(ep.device_ordinal, 0), timeout_ms, block_size, block_count,
+            ctypes.byref(err))
         if not conn:
             raise ConnectionError(
                 f"native tpu connect to {ep} failed: errno={err.value}")
@@ -518,6 +522,8 @@ class NativeDataplane:
                     kind = ev.kind
                     if kind == EV_RESPONSE:
                         self._on_fast_response(ev)
+                    elif kind == EV_RESPONSE_ZC:
+                        self._on_fast_response_zc(ev)
                     elif kind == EV_REQUEST:
                         item = self._crack_fast_request(ev)
                         if item is not None:
@@ -598,6 +604,50 @@ class NativeDataplane:
                 "utf-8", "replace")
         body_b = ctypes.string_at(ev.body, ev.body_len) if ev.body_len else b""
         self._process_frame(sock, 0, None, body_b, prebuilt_meta=meta)
+
+    def _on_fast_response_zc(self, ev) -> None:
+        """Zero-copy tunnel response: the payload sits in our registered
+        pool blocks. Python consumers need contiguous bytes, so copy the
+        views out (ONE copy — the stream-reassembly copy was skipped
+        engine-side), then return the credits via dp_tpu_ack."""
+        meta_b = ctypes.string_at(ev.meta, ev.meta_len)
+        attempt, att_size = struct.unpack_from("<QQ", meta_b, 0)
+        nv = struct.unpack_from("<I", meta_b, _RESP_HDR)[0]
+        off = _RESP_HDR + 4
+        parts = []
+        for _ in range(nv):
+            p, ln = struct.unpack_from("<QQ", meta_b, off)
+            off += 16
+            if ln:
+                parts.append(ctypes.string_at(p, ln))
+        alen = struct.unpack_from("<I", meta_b, off)[0]
+        ack = meta_b[off + 4:off + 4 + alen]
+        etext = meta_b[off + 4 + alen:].decode("utf-8", "replace")
+        # credits go back the moment the bytes are copied out
+        self._lib.dp_tpu_ack(self._rt, ev.conn_id, ack, alen)
+        body = b"".join(parts)
+        sock = self._socks.get(ev.conn_id)
+        cid = ev.aux
+        rec = sock._fast_calls.pop(cid, None) if sock is not None else None
+        if rec is not None:
+            rec.code = ev.tag
+            rec.text = etext if ev.tag else ""
+            rec.att_size = att_size
+            rec.body = body
+            sock.in_messages += 1
+            sock.in_bytes += len(body)
+            rec.finish()
+            return
+        if sock is None:
+            return
+        meta = rpc_meta_pb2.RpcMeta()
+        meta.correlation_id = cid
+        meta.attempt_version = attempt
+        meta.attachment_size = att_size
+        meta.response.error_code = ev.tag
+        if ev.tag:
+            meta.response.error_text = etext
+        self._process_frame(sock, 0, None, body, prebuilt_meta=meta)
 
     def _sweep_fast_timeouts(self, now: float) -> None:
         """Async fast calls have no per-call timer (that is the point);

@@ -279,16 +279,25 @@ def fast_process_request(item) -> None:
     conn = sock.conn_id
     q = on_flusher_thread()
 
-    def send_error(code: int, text: str = "") -> None:
-        dp.respond(conn, cid, attempt, code,
-                   (text or errors.error_text(code)).encode(), b"", b"", q)
-
     if server is None:
         return
     if (server.options.auth is not None
             or server.options.interceptor is not None
             or server.rpc_dumper is not None):
         return _replay_full(item)
+    from brpc_tpu.trace import span as _span
+
+    # span exists BEFORE admission: rejected requests must reach /rpcz
+    # too (slow-path contract, send_error above)
+    span = _span.start_server_span_ids(trace_id, span_id, svc, meth,
+                                       peer=str(sock.remote))
+
+    def send_error(code: int, text: str = "") -> None:
+        if span is not None:
+            span.end(code)
+        dp.respond(conn, cid, attempt, code,
+                   (text or errors.error_text(code)).encode(), b"", b"", q)
+
     server.requests_processed.put(1)
     if not server.is_running:
         return send_error(errors.ELOGOFF)
@@ -321,11 +330,8 @@ def fast_process_request(item) -> None:
         server.sub_concurrency()
         return send_error(errors.ELIMIT, "method concurrency limit")
 
-    from brpc_tpu.trace import span as _span
-
     cntl = FastServerController(server, sock, svc, meth, log_id, timeout_ms)
-    cntl.span = _span.start_server_span_ids(trace_id, span_id, svc, meth,
-                                            peer=str(sock.remote))
+    cntl.span = span
     if att_size:
         cntl.request_attachment = body[len(body) - att_size:]
         body = body[:len(body) - att_size]

@@ -79,6 +79,20 @@ _SEG_SIZE = struct.calcsize(SEG_FMT)
 
 DEFAULT_BLOCK_SIZE = 256 * 1024
 DEFAULT_BLOCK_COUNT = 64      # 16 MB window per direction
+
+
+def clamp_geometry(bs: int, bc: int):
+    """Sane bounds for a negotiated pool geometry (a peer must not be able
+    to demand an absurd registration; dataplane.cpp tpu_clamp_geometry is
+    the native mirror)."""
+    bs = bs or DEFAULT_BLOCK_SIZE
+    bc = bc or DEFAULT_BLOCK_COUNT
+    bs = max(16 << 10, min(4 << 20, bs))
+    bs = (bs + 4095) & ~4095
+    bc = max(4, min(512, bc))
+    while bs * bc > (512 << 20) and bc > 4:
+        bc //= 2
+    return bs, bc
 INLINE_MAX = 16 * 1024        # small messages skip the block pool entirely
 MAX_SEGS_PER_FRAME = 32
 HANDSHAKE_VERSION = 1
@@ -300,7 +314,14 @@ class TpuEndpoint:
         self.role = role                  # "client" | "server"
         self.server = server              # owning Server (server role)
         self.target_ordinal = target_ordinal
-        self.recv_pool = BlockPool(block_size, block_count)
+        if role == "server":
+            # window negotiation: the receive pool is created at HELLO
+            # time, mirroring the dialer's geometry (reference negotiates
+            # queue geometry in its handshake, rdma_endpoint.cpp:127-130)
+            self.recv_pool = None
+        else:
+            self.recv_pool = BlockPool(*clamp_geometry(block_size,
+                                                       block_count))
         self.window: Optional[PeerWindow] = None
         self.inline_only = False          # cross-host fallback
         self.peer_ordinal = -1
@@ -356,6 +377,10 @@ class TpuEndpoint:
         does not front is refused, not silently served."""
         info = json.loads(body.decode())
         requested = int(info.get("ordinal", 0))
+        if self.recv_pool is None:
+            # mirror the dialer's window geometry for our receive pool
+            self.recv_pool = BlockPool(*clamp_geometry(
+                int(info.get("bs", 0) or 0), int(info.get("bc", 0) or 0)))
         bound = getattr(self.server, "_tpu_ordinal", -1) \
             if self.server is not None else -1
         if bound >= 0 and requested != bound:
@@ -506,6 +531,10 @@ class TpuEndpoint:
         arrival order, ACK the consumed blocks, cut complete messages
         (processing itself fans out to fiber workers in cut_messages)."""
         inline_len, nsegs = struct.unpack_from(DATA_BODY_HDR, body)
+        if nsegs and self.recv_pool is None:
+            # block refs before the HELLO created our pool: protocol abuse
+            self.fail(errors.EREQUEST, "DATA before HELLO")
+            return
         vsock = self.vsock
         got = 0
         if inline_len:
@@ -551,7 +580,8 @@ class TpuEndpoint:
             self.vsock.set_failed(code, reason)
         if self.window is not None:
             self.window.close()
-        self.recv_pool.close()
+        if self.recv_pool is not None:  # server may die pre-HELLO
+            self.recv_pool.close()
         if not self.ctrl.failed:
             self.ctrl.set_failed(code if code else errors.EFAILEDSOCKET,
                                  f"tpu tunnel down: {reason}")
