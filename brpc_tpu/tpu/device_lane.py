@@ -1,0 +1,381 @@
+"""device_lane — device-resident RPC payloads (the honest ICI-analog).
+
+SURVEY §5.8 maps the reference's RDMA transport onto the PJRT transfer
+engine. Round-3 measurement (docs/round3-notes.md) showed this
+environment's host↔HBM wire (an axon-tunneled chip) runs at 0.65 GB/s up
+and ~5 MB/s down — two orders of magnitude under the shm transport — so
+staging every RPC payload through the device would be theater, not
+engineering. What real TPU systems do instead: tensors LIVE in HBM, the
+host orchestrates, and data-plane movement happens on-device (ICI for
+multi-chip). This module gives the RPC framework exactly that contract:
+
+- ``DeviceStore``: handle -> jax.Array registry on the serving process's
+  chip. Handles are small integers that ride normal RPC responses; the
+  payload bytes stay in HBM.
+- ``DeviceDataService``: a standard Service (full policy path — runs over
+  any transport: TCP, the shm tunnel, h2) exposing
+  ``Put`` (attachment -> HBM, returns handle), ``Copy`` (handle -> new
+  handle, on-device DMA — the data-plane op), ``Stats`` (bytes resident /
+  moved), ``Get`` (handle -> attachment) and ``Free``.
+- Device methods for the in-process TpuSocket lane (tpu/tpusocket.py)
+  registered under the same names.
+
+``Copy`` dispatches asynchronously (jax async dispatch IS the DMA queue);
+pipelined Copy RPCs overlap on the device like pipelined RDMA writes on a
+QP — the per-op sync happens only when a result is fetched or ``Stats``
+asks for a fence.
+
+Reference counterpart: rdma/block_pool.cpp registers memory once and
+moves data by reference; here HBM is the registered memory and handles
+are the references.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from brpc_tpu.metrics.reducer import Adder
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.server import Service
+from brpc_tpu.proto import device_lane_pb2
+
+g_device_resident_bytes = Adder()
+g_device_moved_bytes = Adder()
+
+
+class DeviceStore:
+    """handle -> device array registry for one process's chip."""
+
+    def __init__(self, device=None):
+        import collections
+        import jax
+
+        self._device = device if device is not None else jax.devices()[0]
+        self._lock = threading.Lock()
+        self._next = 1
+        self._arrays: Dict[int, object] = {}
+        self._copy_fn = None
+        # transient copy outputs: held long enough to be fence-able, then
+        # dropped — sustained data-plane traffic must not grow residency
+        # until the allocator thrashes
+        self._transient = collections.deque(maxlen=32)
+        # dispatch coalescing (measured on the tunneled chip: an ISOLATED
+        # dispatch costs ~7ms of command latency, back-to-back dispatches
+        # batch down to ~20us/op) — transient copies queue here and a
+        # dedicated thread issues them contiguously, the command-buffer
+        # trick every real device runtime plays
+        self._dq = collections.deque()
+        self._dq_cv = threading.Condition()
+        self._dq_thread = None
+        self._dq_busy = False
+        self._batch_fns: Dict[int, object] = {}  # k -> fused copy program
+        # per-STORE accounting (the global Adders below aggregate across
+        # stores for /vars; Stats answers for THIS store)
+        self._resident_bytes = 0
+        self._moved_bytes = 0
+
+    @property
+    def device(self):
+        return self._device
+
+    # ------------------------------------------------------------- data plane
+    def put(self, data: bytes) -> Tuple[int, int]:
+        """Stage bytes into HBM (the one host->device crossing); returns
+        (handle, nbytes)."""
+        import jax
+
+        arr = jax.device_put(np.frombuffer(data, dtype=np.uint8),
+                             self._device)
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._arrays[h] = arr
+            self._resident_bytes += len(data)
+        g_device_resident_bytes.put(len(data))
+        return h, len(data)
+
+    def copy(self, handle: int,
+             transient: bool = False) -> Optional[Tuple[int, int]]:
+        """On-device copy: HBM -> HBM through the compiled datapath (async
+        dispatch; this is the device data-plane op RPCs orchestrate).
+        transient=True keeps the output only in a bounded ring (handle 0):
+        sustained traffic measured without growing residency."""
+        import jax
+        import jax.numpy as jnp
+
+        with self._lock:
+            arr = self._arrays.get(handle)
+        if arr is None:
+            return None
+        if self._copy_fn is None:
+            self._copy_fn = jax.jit(lambda x: x + jnp.uint8(0),
+                                    device=self._device)
+        n = arr.nbytes
+        if transient:
+            # coalesced dispatch: the RPC answers with handle 0 now; the
+            # dispatcher thread issues queued copies back-to-back
+            with self._dq_cv:
+                if self._dq_thread is None:
+                    self._dq_thread = threading.Thread(
+                        target=self._dispatch_loop, daemon=True,
+                        name="brpc-device-dispatch")
+                    self._dq_thread.start()
+                self._dq.append(arr)
+                self._dq_cv.notify()
+            with self._lock:
+                self._moved_bytes += 2 * n
+            g_device_moved_bytes.put(2 * n)
+            return 0, n
+        out = self._copy_fn(arr)  # async: queues DMA, returns immediately
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._arrays[h] = out
+            self._resident_bytes += n
+            self._moved_bytes += 2 * n
+        g_device_resident_bytes.put(n)
+        g_device_moved_bytes.put(2 * n)  # read + write through HBM
+        return h, n
+
+    def pump(self, handle: int, rounds: int) -> Optional[Tuple[int, int]]:
+        """`rounds` HBM echo round trips over the array via the Pallas copy
+        loop (tpu/bench_kernels.echo_loop_probe) with a DEPENDENT 4-byte
+        fetch — the only completion signal this environment's runtime
+        cannot fake (block_until_ready is unreliable through the axon
+        relay; docs/round3-notes.md). Returns (checksum, moved_bytes)."""
+        import jax
+        import jax.numpy as jnp
+
+        from brpc_tpu.tpu.bench_kernels import echo_loop_probe
+
+        with self._lock:
+            arr = self._arrays.get(handle)
+        if arr is None:
+            return None
+        rounds = max(1, min(int(rounds), 100000))
+        lanes = 2048
+        words = arr.nbytes // 4
+        rows = max(1, words // lanes)
+        use = rows * lanes * 4
+        if use > arr.nbytes:
+            return None  # need at least one full row
+        x8 = arr[:use].reshape(rows, lanes, 4)
+        x2d = jax.lax.bitcast_convert_type(x8, jnp.int32).reshape(rows,
+                                                                  lanes)
+        interpret = jax.default_backend() != "tpu"
+        val = echo_loop_probe(x2d, rounds=rounds, interpret=interpret)
+        checksum = int(jax.device_get(val))  # dependent fetch = real sync
+        moved = 4 * rounds * use  # 2 copies x (read+write) per round
+        with self._lock:
+            self._moved_bytes += moved
+        g_device_moved_bytes.put(moved)
+        return checksum, moved
+
+    def get(self, handle: int) -> Optional[bytes]:
+        with self._lock:
+            arr = self._arrays.get(handle)
+        if arr is None:
+            return None
+        return np.asarray(arr).tobytes()
+
+    def free(self, handle: int) -> bool:
+        with self._lock:
+            arr = self._arrays.pop(handle, None)
+            if arr is not None:
+                self._resident_bytes -= arr.nbytes
+        if arr is None:
+            return False
+        g_device_resident_bytes.put(-arr.nbytes)
+        return True
+
+    def _batched_copy_fn(self, k: int):
+        """One compiled program copying k arrays — a whole queue drain is
+        ONE dispatch. Under a busy server the GIL opens ~5ms gaps between
+        Python-level dispatches, which defeats device command coalescing
+        entirely (measured: isolated op ~7ms on the tunneled chip vs
+        ~20us coalesced); fusing k ops into one executable sidesteps the
+        interpreter, the classic XLA batch-the-work move."""
+        import jax
+        import jax.numpy as jnp
+
+        fn = self._batch_fns.get(k)
+        if fn is None:
+            fn = jax.jit(lambda *xs: tuple(x + jnp.uint8(0) for x in xs))
+            self._batch_fns[k] = fn
+        return fn
+
+    def _dispatch_loop(self) -> None:
+        import logging
+
+        while True:
+            with self._dq_cv:
+                while not self._dq:
+                    self._dq_busy = False
+                    self._dq_cv.notify_all()  # fence waiters
+                    self._dq_cv.wait()
+                self._dq_busy = True
+                batch = list(self._dq)
+                self._dq.clear()
+            try:
+                # group same-spec arrays, pad to a power-of-two bucket so
+                # the jit cache stays small, run each group as one dispatch
+                groups = {}
+                for a in batch:
+                    groups.setdefault((a.shape, str(a.dtype)), []).append(a)
+                for arrs in groups.values():
+                    i = 0
+                    while i < len(arrs):
+                        left = len(arrs) - i
+                        k = 1
+                        while k * 2 <= min(left, 32):
+                            k *= 2
+                        fn = self._batched_copy_fn(k)
+                        outs = fn(*arrs[i:i + k])
+                        self._transient.extend(outs)
+                        i += k
+            except Exception:
+                # the thread must survive (a dead dispatcher with
+                # _dq_busy=True wedges every fence() forever); the dropped
+                # batch only loses transient outputs
+                logging.getLogger("brpc_tpu").exception(
+                    "device dispatch batch failed (dropped)")
+
+    def fence(self) -> None:
+        """Block until every queued device op has retired."""
+        with self._dq_cv:
+            while self._dq or self._dq_busy:
+                self._dq_cv.wait(0.01)
+        with self._lock:
+            arrs = list(self._arrays.values())
+        for a in arrs:
+            a.block_until_ready()
+        for a in list(self._transient):
+            a.block_until_ready()
+
+    def stats(self) -> Tuple[int, int, int]:
+        with self._lock:
+            return (len(self._arrays), self._resident_bytes,
+                    self._moved_bytes)
+
+
+_store: Optional[DeviceStore] = None
+_store_lock = threading.Lock()
+
+
+def global_store() -> DeviceStore:
+    global _store
+    with _store_lock:
+        if _store is None:
+            _store = DeviceStore()
+        return _store
+
+
+class DeviceDataService(Service):
+    """Device-resident payload service over the normal RPC stack (full
+    policy path; any transport). Payload bytes ride attachments exactly
+    once (Put/Get); Copy moves data purely on-device."""
+
+    DESCRIPTOR = device_lane_pb2.DESCRIPTOR.services_by_name[
+        "DeviceDataService"]
+
+    def __init__(self, store: Optional[DeviceStore] = None):
+        super().__init__()
+        self.store = store or global_store()
+
+    def Put(self, cntl, request, done):
+        handle, n = self.store.put(cntl.request_attachment)
+        return device_lane_pb2.DeviceHandle(handle=handle, nbytes=n)
+
+    def Copy(self, cntl, request, done):
+        # request.nbytes == -1: transient output (bounded ring, handle 0)
+        out = self.store.copy(request.handle,
+                              transient=request.nbytes == -1)
+        if out is None:
+            cntl.set_failed(errors.ENOMETHOD,
+                            f"no device handle {request.handle}")
+            return device_lane_pb2.DeviceHandle()
+        h, n = out
+        return device_lane_pb2.DeviceHandle(handle=h, nbytes=n)
+
+    def Pump(self, cntl, request, done):
+        out = self.store.pump(request.handle, request.rounds)
+        if out is None:
+            cntl.set_failed(errors.ENOMETHOD,
+                            f"no pumpable device handle {request.handle}")
+            return device_lane_pb2.PumpResult()
+        checksum, moved = out
+        return device_lane_pb2.PumpResult(checksum=checksum,
+                                          moved_bytes=moved)
+
+    def Get(self, cntl, request, done):
+        data = self.store.get(request.handle)
+        if data is None:
+            cntl.set_failed(errors.ENOMETHOD,
+                            f"no device handle {request.handle}")
+            return device_lane_pb2.DeviceHandle()
+        cntl.response_attachment = data
+        return device_lane_pb2.DeviceHandle(handle=request.handle,
+                                            nbytes=len(data))
+
+    def Free(self, cntl, request, done):
+        ok = self.store.free(request.handle)
+        return device_lane_pb2.DeviceHandle(
+            handle=request.handle if ok else 0)
+
+    def Stats(self, cntl, request, done):
+        if request.fence:
+            self.store.fence()
+        count, resident, moved = self.store.stats()
+        return device_lane_pb2.DeviceStats(
+            handles=count, resident_bytes=resident, moved_bytes=moved)
+
+
+# ---------------------------------------------------------------------------
+# in-process TpuSocket lane (tpu/tpusocket.py): the same service addressable
+# as device programs on a local chip (tpu://host/ordinal, no port)
+# ---------------------------------------------------------------------------
+_tpusock_svc: Optional[DeviceDataService] = None
+
+
+def _tpusock_call(device, meta, payload: bytes, attachment: bytes,
+                  method: str):
+    # one service instance (the descriptor walk in Service.__init__ is
+    # per-RPC waste otherwise); the store is the global singleton anyway
+    global _tpusock_svc
+    svc = _tpusock_svc
+    if svc is None:
+        svc = _tpusock_svc = DeviceDataService(global_store())
+
+    class _Cntl:
+        request_attachment = attachment
+        response_attachment = b""
+
+        def set_failed(self, code, text=""):
+            self._err = (code, text)
+
+        _err = None
+
+    req_cls = svc.find_method(method).request_class
+    req = req_cls()
+    req.ParseFromString(payload)
+    cntl = _Cntl()
+    resp = getattr(svc, method)(cntl, req, None)
+    if cntl._err is not None:
+        return cntl._err[0], b"", b""
+    return 0, resp.SerializeToString(), cntl.response_attachment
+
+
+def _register_tpusocket_methods() -> None:
+    from brpc_tpu.tpu.tpusocket import register_device_method
+
+    for m in ("Put", "Copy", "Pump", "Get", "Free", "Stats"):
+        register_device_method(
+            "DeviceDataService", m,
+            lambda device, meta, p, a, _m=m: _tpusock_call(device, meta,
+                                                           p, a, _m))
+
+
+_register_tpusocket_methods()

@@ -81,8 +81,15 @@ _echo_jit_cache: Dict[int, Callable] = {}
 def _device_echo(device, meta, payload: bytes, attachment: bytes):
     """EchoService.Echo on a chip: payload + attachment round-trip HBM.
 
-    The response message mirrors the request message; bulk bytes move as a
-    uint8 array through device memory (the 1MB-echo benchmark datapath).
+    Deliberately SYNCHRONOUS (dispatch + materialize in one frame): a
+    deferred np.asarray of an async-dispatched result reliably aborts this
+    environment's jax build at interpreter exit ("FATAL: exception not
+    rethrown" out of the axon plugin teardown — reproduced and bisected in
+    round 3). Device-side overlap for pipelined traffic lives in the
+    device-resident lane instead (tpu/device_lane.py: async Copy with
+    fused batch dispatch never materializes on the host), which is also
+    where bulk-throughput callers should be — this echo pays a full
+    host->HBM->host round trip per call by design.
     """
     import jax
     import jax.numpy as jnp
@@ -92,19 +99,19 @@ def _device_echo(device, meta, payload: bytes, attachment: bytes):
     req = echo_pb2.EchoRequest()
     req.ParseFromString(payload)
     blob = req.payload + attachment
-    if blob:
-        arr = np.frombuffer(blob, dtype=np.uint8)
-        on_dev = jax.device_put(arr, device)
-        fn = _echo_jit_cache.get(device.id)
-        if fn is None:
-            fn = jax.jit(lambda x: x + jnp.uint8(0), device=device)
-            _echo_jit_cache[device.id] = fn
-        back = np.asarray(fn(on_dev))
-        blob_out = back.tobytes()
-        payload_out = blob_out[: len(req.payload)]
-        att_out = blob_out[len(req.payload):]
-    else:
-        payload_out, att_out = b"", b""
+    if not blob:
+        resp = echo_pb2.EchoResponse(message=req.message)
+        return errors.OK, resp.SerializeToString(), b""
+    arr = np.frombuffer(blob, dtype=np.uint8)
+    on_dev = jax.device_put(arr, device)
+    fn = _echo_jit_cache.get(device.id)
+    if fn is None:
+        fn = jax.jit(lambda x: x + jnp.uint8(0), device=device)
+        _echo_jit_cache[device.id] = fn
+    back = np.asarray(fn(on_dev))
+    blob_out = back.tobytes()
+    payload_out = blob_out[: len(req.payload)]
+    att_out = blob_out[len(req.payload):]
     resp = echo_pb2.EchoResponse(message=req.message, payload=payload_out)
     return errors.OK, resp.SerializeToString(), att_out
 
@@ -206,19 +213,15 @@ class TpuSocket:
         handler = _registry.find(meta.request.service_name,
                                  meta.request.method_name)
         payload, attachment = TrpcStdProtocol.split_attachment(msg)
+        err_text = ""
         if handler is None:
-            code, resp_payload, att_out = (
-                errors.ENOMETHOD, b"",
-                b"",
-            )
-            err_text = (f"no device method "
-                        f"{meta.request.service_name}.{meta.request.method_name}")
+            code, resp_payload, att_out = errors.ENOMETHOD, b"", b""
+            err_text = (f"no device method {meta.request.service_name}."
+                        f"{meta.request.method_name}")
         else:
-            err_text = ""
             try:
                 code, resp_payload, att_out = handler(
-                    self.device, meta, payload, attachment
-                )
+                    self.device, meta, payload, attachment)
             except Exception as e:
                 code, resp_payload, att_out = errors.EINTERNAL, b"", b""
                 err_text = f"device method raised: {e}"

@@ -1,0 +1,34 @@
+"""Real-hardware test lane (VERDICT r2 #6).
+
+Unlike tests/ (which forces the virtual 8-device CPU mesh), this lane
+runs on whatever real accelerator the process sees — under axon, the one
+tunneled TPU chip. Run it explicitly:
+
+    python -m pytest tests_hw -q          # needs the chip; skips on CPU
+
+It is intentionally OUTSIDE tests/ because pytest runs one process and
+the CPU forcing in tests/conftest.py is irreversible once jax
+initializes. bench.py runs this lane's kernel benchmark via
+tools/kernel_bench.py so BENCH_r03 carries kernel numbers.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "hardware: needs a real accelerator (excluded from the "
+        "CPU-mesh suite)")
+
+
+@pytest.fixture(scope="session")
+def tpu_device():
+    jax = pytest.importorskip("jax")
+    if jax.default_backend() != "tpu":
+        pytest.skip("no TPU visible (run without JAX_PLATFORMS=cpu)")
+    return jax.devices()[0]

@@ -1,0 +1,126 @@
+"""Kernels and device lanes on the real chip (VERDICT r2 #6: the
+hardware-only coverage that the CPU-mesh suite permanently skips).
+
+Every test here states a CORRECTNESS property; timing lives in
+tools/kernel_bench.py (bench.py runs it for BENCH_r03).
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.hardware
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+
+class TestKernelsOnChip:
+    def test_flash_attention_mxu(self, tpu_device):
+        from brpc_tpu.tpu.pallas_ops import (attention_reference,
+                                             flash_attention)
+
+        rng = np.random.default_rng(0)
+        S, D = 1024, 128
+        q = jnp.asarray(rng.normal(size=(S, D)), dtype=jnp.bfloat16)
+        k = jnp.asarray(rng.normal(size=(S, D)), dtype=jnp.bfloat16)
+        v = jnp.asarray(rng.normal(size=(S, D)), dtype=jnp.bfloat16)
+        for causal in (False, True):
+            out = flash_attention(q, k, v, causal=causal, interpret=False)
+            ref = attention_reference(q, k, v, causal=causal)
+            np.testing.assert_allclose(
+                np.asarray(out, dtype=np.float32),
+                np.asarray(ref, dtype=np.float32), rtol=0.1, atol=0.06)
+
+    def test_flash_carry_matches_one_shot(self, tpu_device):
+        # carry form seeded with the identity state + one pass + normalize
+        # == the one-shot kernel (the ring-hop contract)
+        from brpc_tpu.tpu.pallas_ops import (NEG_INF, flash_attention,
+                                             flash_attention_carry)
+
+        rng = np.random.default_rng(1)
+        S, D = 512, 128
+        q = jnp.asarray(rng.normal(size=(S, D)), dtype=jnp.float32)
+        k = jnp.asarray(rng.normal(size=(S, D)), dtype=jnp.float32)
+        v = jnp.asarray(rng.normal(size=(S, D)), dtype=jnp.float32)
+        m0 = jnp.full((S, 1), NEG_INF, dtype=jnp.float32)
+        l0 = jnp.zeros((S, 1), dtype=jnp.float32)
+        a0 = jnp.zeros((S, D), dtype=jnp.float32)
+        m, l, acc = flash_attention_carry(q, k, v, m0, l0, a0, 0, 0,
+                                          causal=True, interpret=False)
+        out = acc / jnp.where(l == 0, 1.0, l)
+        ref = flash_attention(q, k, v, causal=True, interpret=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_carry_split_kv_matches_whole(self, tpu_device):
+        # two sequential carry passes over split KV == one pass over all of
+        # it (exactly what ring hops do)
+        from brpc_tpu.tpu.pallas_ops import NEG_INF, flash_attention_carry
+
+        rng = np.random.default_rng(2)
+        S, D = 512, 128
+        q = jnp.asarray(rng.normal(size=(S, D)), dtype=jnp.float32)
+        k = jnp.asarray(rng.normal(size=(S, D)), dtype=jnp.float32)
+        v = jnp.asarray(rng.normal(size=(S, D)), dtype=jnp.float32)
+        m0 = jnp.full((S, 1), NEG_INF, dtype=jnp.float32)
+        l0 = jnp.zeros((S, 1), dtype=jnp.float32)
+        a0 = jnp.zeros((S, D), dtype=jnp.float32)
+        m1, l1, a1 = flash_attention_carry(q, k[:256], v[:256], m0, l0, a0,
+                                           0, 0, causal=True,
+                                           interpret=False)
+        m2, l2, a2 = flash_attention_carry(q, k[256:], v[256:], m1, l1, a1,
+                                           0, 256, causal=True,
+                                           interpret=False)
+        out_split = a2 / jnp.where(l2 == 0, 1.0, l2)
+        mw, lw, aw = flash_attention_carry(q, k, v, m0, l0, a0, 0, 0,
+                                           causal=True, interpret=False)
+        out_whole = aw / jnp.where(lw == 0, 1.0, lw)
+        np.testing.assert_allclose(np.asarray(out_split),
+                                   np.asarray(out_whole),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fused_xent_on_chip(self, tpu_device):
+        from brpc_tpu.tpu.pallas_ops import softmax_xent, softmax_xent_reference
+
+        rng = np.random.default_rng(3)
+        logits = jnp.asarray(rng.normal(size=(512, 2048)), dtype=jnp.float32)
+        targets = jnp.asarray(rng.integers(0, 2048, size=(512,)))
+        got = softmax_xent(logits, targets, interpret=False)
+        want = softmax_xent_reference(logits, targets)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-4)
+
+    def test_rmsnorm_on_chip(self, tpu_device):
+        from brpc_tpu.tpu.pallas_ops import rmsnorm, rmsnorm_reference
+
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.normal(size=(1024, 512)), dtype=jnp.bfloat16)
+        w = jnp.asarray(rng.normal(size=(512,)), dtype=jnp.bfloat16)
+        got = rmsnorm(x, w, interpret=False)
+        want = rmsnorm_reference(x, w)
+        np.testing.assert_allclose(np.asarray(got, dtype=np.float32),
+                                   np.asarray(want, dtype=np.float32),
+                                   rtol=0.05, atol=0.05)
+
+
+class TestDeviceLanesOnChip:
+    def test_tpusocket_device_echo(self, tpu_device):
+        from brpc_tpu.proto import echo_pb2
+        from brpc_tpu.rpc import Channel, ChannelOptions, Controller, Stub
+
+        ch = Channel(ChannelOptions(timeout_ms=120000)).init("tpu://0")
+        stub = Stub(ch, echo_pb2.DESCRIPTOR.services_by_name["EchoService"])
+        payload = bytes(range(256)) * 256  # 64KB through HBM
+        r = stub.Echo(echo_pb2.EchoRequest(message="hw", payload=payload))
+        assert r.message == "hw"
+        assert r.payload == payload
+
+    def test_device_store_on_chip(self, tpu_device):
+        from brpc_tpu.tpu.device_lane import DeviceStore
+
+        store = DeviceStore(tpu_device)
+        blob = bytes(range(256)) * 1024
+        h, n = store.put(blob)
+        checksum, moved = store.pump(h, rounds=2)
+        checksum2, _ = store.pump(h, rounds=5)
+        assert checksum == checksum2  # copies preserve data
+        assert store.get(h) == blob

@@ -40,10 +40,17 @@ def main(argv=None):
                     help="run user methods inline on the native poller "
                          "(the reference's usercode-in-parsing-bthread "
                          "default; safe for non-blocking handlers)")
+    ap.add_argument("--device", action="store_true",
+                    help="serve DeviceDataService (this process owns the "
+                         "chip; payloads live in HBM, tpu/device_lane.py)")
     args = ap.parse_args(argv)
     server = Server(ServerOptions(native_dataplane=args.native,
                                   usercode_inline=args.inline))
     server.add_service(EchoServiceImpl())
+    if args.device:
+        from brpc_tpu.tpu.device_lane import DeviceDataService
+
+        server.add_service(DeviceDataService())
     server.start(args.listen)
     if args.native_echo:
         server.register_native_echo("EchoService", "Echo")
