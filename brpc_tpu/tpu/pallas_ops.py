@@ -183,6 +183,121 @@ def flash_attention_mha(q, k, v, causal: bool = False, **kw):
 
 
 # ---------------------------------------------------------------------------
+# Carry-form flash attention — the ring-attention inner kernel (VERDICT r2
+# #5): instead of normalizing at the end, the running (m, l, acc) online-
+# softmax state enters as inputs and leaves as outputs, so hops of a KV
+# ring accumulate through the SAME kernel; the ring normalizes once after
+# the last hop. Causal masking uses ABSOLUTE positions fed at runtime
+# (each hop's KV block originated on a different device).
+# ---------------------------------------------------------------------------
+def _flash_carry_kernel(pos_ref, q_ref, k_ref, v_ref, m_in, l_in, acc_in,
+                        m_out, l_out, acc_out, m_scr, l_scr, acc_scr, *,
+                        causal: bool, bq: int, bk: int, nk: int):
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(0)
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = m_in[:]
+        l_scr[:] = l_in[:]
+        acc_scr[:] = acc_in[:]
+
+    q = q_ref[:].astype(jnp.float32)
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    s = jnp.dot(q, k.T) / jnp.sqrt(jnp.float32(q.shape[-1]))
+    if causal:
+        q_pos = pos_ref[0, 0] + qi * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 0)
+        k_pos = pos_ref[0, 1] + ki * bk + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    m_prev = m_scr[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    # rows that have seen nothing but masked scores (whole-hop-in-the-
+    # future blocks) must stay at the identity, not exp(-inf - -inf) = 1
+    alive = m_new > NEG_INF / 2
+    p = jnp.where(alive, jnp.exp(s - m_new), 0.0)
+    alpha = jnp.where(alive, jnp.exp(m_prev - m_new), 0.0)
+    l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jnp.dot(p, v)
+    m_scr[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        m_out[:] = m_scr[:]
+        l_out[:] = l_scr[:]
+        acc_out[:] = acc_scr[:]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret", "vma"))
+def flash_attention_carry(q, k, v, m, l, acc, q_start, k_start,
+                          causal: bool = False, block_q: int = 128,
+                          block_k: int = 128, interpret: bool = None,
+                          vma=None):
+    """One online-softmax accumulation pass over (k, v) for queries q,
+    continuing running state. q: [sq, D]; k,v: [sk, D]; m, l: [sq, 1]
+    float32; acc: [sq, D] float32; q_start/k_start: absolute sequence
+    offsets (traced scalars) for causal masking. Returns (m', l', acc').
+    Normalize with acc/l after the final pass. ``vma``: varying mesh axes
+    when called inside a shard_map (ring attention passes its sharded
+    axes so shard_map's varying-axes checker can type the outputs)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = not _on_tpu()
+    sq, d = q.shape
+    sk = k.shape[0]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    if sq % bq or sk % bk:
+        raise ValueError(f"seq lengths ({sq},{sk}) must divide blocks "
+                         f"({bq},{bk})")
+    nq, nk = sq // bq, sk // bk
+    pos = jnp.stack([jnp.asarray(q_start, jnp.int32),
+                     jnp.asarray(k_start, jnp.int32)])[None, :]
+    kernel = functools.partial(_flash_carry_kernel, causal=causal, bq=bq,
+                               bk=bk, nk=nk)
+    return pl.pallas_call(
+        kernel,
+        grid=(nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 2), lambda qi, ki: (0, 0)),
+            pl.BlockSpec((bq, d), lambda qi, ki: (qi, 0)),
+            pl.BlockSpec((bk, d), lambda qi, ki: (ki, 0)),
+            pl.BlockSpec((bk, d), lambda qi, ki: (ki, 0)),
+            pl.BlockSpec((bq, 1), lambda qi, ki: (qi, 0)),
+            pl.BlockSpec((bq, 1), lambda qi, ki: (qi, 0)),
+            pl.BlockSpec((bq, d), lambda qi, ki: (qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bq, 1), lambda qi, ki: (qi, 0)),
+            pl.BlockSpec((bq, 1), lambda qi, ki: (qi, 0)),
+            pl.BlockSpec((bq, d), lambda qi, ki: (qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((sq, 1), jnp.float32,
+                                 vma=set(vma) if vma else None),
+            jax.ShapeDtypeStruct((sq, 1), jnp.float32,
+                                 vma=set(vma) if vma else None),
+            jax.ShapeDtypeStruct((sq, d), jnp.float32,
+                                 vma=set(vma) if vma else None),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(pos, q, k, v, m, l, acc)
+
+
+# ---------------------------------------------------------------------------
 # Fused softmax cross-entropy — the other canonical memory-bound fusion:
 # per row, one VMEM pass computes max / logsumexp / target logit without
 # materializing the [rows, V] log-softmax in HBM.
