@@ -1413,7 +1413,7 @@ bool tpu_try_zero_copy(Runtime* rt, const std::shared_ptr<Conn>& c,
   // route 2: client-side response on a fast conn — deliver views + ack
   if (!c->is_server && c->py_fast.load(std::memory_order_relaxed) &&
       m.has_response && !m.has_request && !m.compress_type && !m.checksum &&
-      !m.has_stream_settings) {
+      !m.has_stream_settings && !m.has_auth) {
     c->in_msgs.fetch_add(1, std::memory_order_relaxed);
     size_t et = m.resp_error_text.size();
     size_t need = sizeof(RespLite) + 4 + views.size() * 16 + 4 +
@@ -2185,11 +2185,19 @@ std::shared_ptr<Conn> create_conn(Runtime* rt, int fd, bool is_server) {
 // whose events must precede the conn's first frame (ACCEPTED ordering).
 void activate_conn(Runtime* rt, const std::shared_ptr<Conn>& c) {
   loop_submit(rt, c->loop, [rt, c] {
+    // under wmu: a writer that queued bytes BEFORE this ADD ran saw its
+    // EPOLL_CTL_MOD fail silently (fd not registered yet) — honoring
+    // want_write here closes the lost-EPOLLOUT race (first large call on
+    // a fresh conn would otherwise truncate and time out)
+    std::lock_guard<std::mutex> wlk(c->wmu);
+    if (c->failed.load() || c->fd < 0) return;
     epoll_event ev{};
-    ev.events = EPOLLIN;
+    ev.events = c->want_write ? (EPOLLIN | EPOLLOUT) : EPOLLIN;
     ev.data.u64 = c->id;
     if (epoll_ctl(rt->loops[c->loop]->epfd, EPOLL_CTL_ADD, c->fd, &ev) != 0) {
-      conn_fail(rt, c, DPE_IO, "epoll add");
+      loop_submit(rt, c->loop, [rt, c] {
+        conn_fail(rt, c, DPE_IO, "epoll add");
+      });
     }
   });
 }

@@ -41,7 +41,8 @@ class _NodeState:
     """Per-node feedback state: latency EWMA, failure streak, and the EMA
     circuit breaker (rpc/circuit_breaker.py) for error-rate isolation."""
 
-    __slots__ = ("latency_ewma_us", "fail_streak", "down_until", "breaker")
+    __slots__ = ("latency_ewma_us", "fail_streak", "down_until", "breaker",
+                 "inflight")
 
     def __init__(self):
         from brpc_tpu.rpc.circuit_breaker import CircuitBreaker
@@ -50,10 +51,16 @@ class _NodeState:
         self.fail_streak = 0
         self.down_until = 0.0
         self.breaker = CircuitBreaker()
+        # calls selected but not yet fed back (la punishes queueing: the
+        # reference charges in-flight requests their expected latency,
+        # locality_aware_load_balancer.cpp)
+        self.inflight = 0
 
     def on_feedback(self, error_code: int, latency_us: float,
                     isolation_s: float = 2.0) -> None:
         self.breaker.on_call_end(error_code, latency_us)
+        if self.inflight > 0:
+            self.inflight -= 1
         if error_code == errors.OK:
             self.fail_streak = 0
             self.latency_ewma_us += 0.2 * (latency_us - self.latency_ewma_us)
@@ -232,30 +239,62 @@ class WeightedRandomLB(LoadBalancer):
 
 class LocalityAwareLB(LoadBalancer):
     """Latency-feedback balancer (policy/locality_aware_load_balancer.cpp):
-    selection probability ~ inverse EWMA latency, so fast replicas absorb
-    more traffic and degraded ones shed it gradually."""
+    a node's share ~ weight / (EWMA latency x (1 + in-flight)). The
+    in-flight term is the reference's queueing punishment: every selected-
+    but-unanswered call charges the node its expected latency again, so a
+    stalling replica sheds load IMMEDIATELY (before any response confirms
+    the stall), and traffic returns as feedback lands. The reference's
+    divide-tree makes the weighted pick O(log n) at its 10k-server scale;
+    cluster sizes here make the O(n) prefix walk the simpler win (the
+    server list already lives in DoublyBufferedData for lock-free reads)."""
 
     name = "la"
 
+    # in-flight charges are repaid by feedback, but selections that never
+    # complete (retry re-picks, recovery shedding, connect failures) would
+    # leak theirs forever — a periodic half-life decay forgives stale
+    # charges so a once-punished node always earns its way back
+    _DECAY_S = 0.5
+
+    def __init__(self):
+        super().__init__()
+        self._last_decay = time.monotonic()
+
+    def _decay_inflight(self) -> None:
+        now = time.monotonic()
+        if now - self._last_decay < self._DECAY_S:
+            return
+        self._last_decay = now
+        with self._state_lock:
+            for st in self._state.values():
+                if st.inflight > 0:
+                    st.inflight //= 2
+
     def select_server(self, cntl=None) -> Optional[EndPoint]:
+        self._decay_inflight()
         with self._servers.read() as lst:
             nodes = self._alive(lst)
             if not nodes:
                 return None
+            states = [self._node_state(n.endpoint) for n in nodes]
             inv = [
-                max(1, n.weight) / max(1.0,
-                                       self._node_state(n.endpoint).latency_ewma_us)
-                for n in nodes
+                max(1, n.weight)
+                / (max(1.0, st.latency_ewma_us) * (1 + max(0, st.inflight)))
+                for n, st in zip(nodes, states)
             ]
             total = sum(inv)
-            # weighted-random draw over inverse latencies
+            # weighted-random draw over punished inverse latencies
             r = (fast_rand_less_than(1 << 30) / float(1 << 30)) * total
             acc = 0.0
-            for n, w in zip(nodes, inv):
+            chosen = nodes[-1]
+            chosen_st = states[-1]
+            for n, st, w in zip(nodes, states, inv):
                 acc += w
                 if r < acc:
-                    return n.endpoint
-            return nodes[-1].endpoint
+                    chosen, chosen_st = n, st
+                    break
+            chosen_st.inflight += 1  # repaid by the call's feedback
+            return chosen.endpoint
 
 
 class ConsistentHashingLB(LoadBalancer):

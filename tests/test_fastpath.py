@@ -379,19 +379,24 @@ def test_zero_copy_rejections_return_credits():
     srv.stop()  # native admission now answers ELOGOFF
     try:
         rejected = 0
+        conn_dead = False
         for _ in range(80):  # 80MB of donated blocks >> the 16MB window
             c2 = Controller()
             c2.request_attachment = blob
             try:
                 stub.Echo(echo_pb2.EchoRequest(message="x"), controller=c2)
             except RpcError as e:
+                if e.error_code == errors.ERPCTIMEDOUT:
+                    pytest.fail("tunnel wedged: rejection leaked its "
+                                "donated blocks' credits")
                 if e.error_code == errors.ELOGOFF:
                     rejected += 1
                 else:
-                    break  # conn torn down (teardown variance): also fine
-        # the tunnel must never WEDGE: either rejections flowed (credits
-        # recycled) or the conn failed fast — both are non-hanging outcomes
-        assert rejected == 0 or rejected >= 1
+                    conn_dead = True  # teardown variance: fail-fast is fine
+                    break
+        # every outcome must be prompt: a long run of ELOGOFFs proves the
+        # credits recycled; a fast conn failure proves nothing hung
+        assert conn_dead or rejected >= 40, (rejected, conn_dead)
     finally:
         srv.stop()
         srv.join()
