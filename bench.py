@@ -340,6 +340,48 @@ def bench_hybrid_native():
         print(f"# hybrid lane 1MB attachment echo: p50="
               f"{lats[len(lats)//2]*1e3:.2f}ms ({gbps:.3f} GB/s)",
               file=sys.stderr)
+        # connection types at 1MB x 4 threads (reference: pooled conns are
+        # how single-peer bulk throughput scales, channel.h:90-95)
+        def _att_echo_threads(ctype):
+            chx = Channel(ChannelOptions(protocol="trpc_std",
+                                         timeout_ms=30000,
+                                         native_transport=True,
+                                         connection_type=ctype))
+            chx.init(srv.endpoint)
+            stubx = Stub(chx, echo_pb2.DESCRIPTOR.services_by_name[
+                "EchoService"])
+            per = 4 if QUICK else 20
+            errs = []
+            barrier = threading.Barrier(5)
+
+            def worker():
+                barrier.wait()
+                try:
+                    for _ in range(per):
+                        c = Controller()
+                        c.request_attachment = att
+                        stubx.Echo(echo_pb2.EchoRequest(message="p"),
+                                   controller=c)
+                        assert len(c.response_attachment) == len(att)
+                except BaseException as e:
+                    errs.append(e)
+
+            ts = [threading.Thread(target=worker) for _ in range(4)]
+            for t in ts:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in ts:
+                t.join()
+            if errs:
+                raise errs[0]
+            wall = time.perf_counter() - t0
+            return 2 * len(att) * 4 * per / wall / 1e9
+
+        g_single = _att_echo_threads("single")
+        g_pooled = _att_echo_threads("pooled")
+        print(f"# hybrid 1MBx4thr: single={g_single:.3f} GB/s  "
+              f"pooled={g_pooled:.3f} GB/s", file=sys.stderr)
     finally:
         srv.close()
 

@@ -72,6 +72,13 @@ class ChannelOptions:
     # poller (reference runs done in the receiving bthread). Only safe for
     # callbacks that never block; off = done runs on a fiber worker.
     done_inline: bool = False
+    # connection type (reference channel.h:90-95): "single" shares one
+    # multiplexed connection per endpoint; "pooled" checks a connection
+    # out of a free list per RPC (one request in flight per conn — how the
+    # reference scales single-peer bulk throughput); "short" dials a fresh
+    # connection per RPC and closes it after. Streaming RPCs always bind
+    # single-style (the stream owns its connection).
+    connection_type: str = "single"
 
 
 class Channel:
@@ -195,6 +202,12 @@ class Channel:
                     errors.EREJECT, "request shed during cluster recovery")
         else:
             ep = self._remote
+        # connection type: streaming binds single-style (the stream owns
+        # its conn); everything else honors options.connection_type
+        ctype = self.options.connection_type
+        if cntl is not None and getattr(cntl, "stream_id", 0):
+            ctype = "single"
+        timeout_ms = int(self.options.connect_timeout_ms)
         if ep.is_tpu():
             if (self.options.native_transport and ep.port
                     and getattr(self._protocol, "magic", None) == b"TRPC"):
@@ -202,8 +215,12 @@ class Channel:
 
                 dp = get_dataplane()
                 if dp is not None:  # native tunnel; Python fallback below
-                    return dp.get_or_connect(
-                        ep, int(self.options.connect_timeout_ms))
+                    if ctype == "pooled":
+                        return self._tag_return(dp.get_pooled(ep, timeout_ms),
+                                                dp.return_pooled)
+                    if ctype == "short":
+                        return dp.connect_short(ep, timeout_ms)
+                    return dp.get_or_connect(ep, timeout_ms)
             from brpc_tpu.tpu.tpusocket import get_tpu_socket
 
             return get_tpu_socket(ep)
@@ -214,17 +231,53 @@ class Channel:
 
             dp = get_dataplane()
             if dp is not None:  # engine unavailable -> Python path below
-                return dp.get_or_connect(
-                    ep, int(self.options.connect_timeout_ms))
+                if ctype == "pooled":
+                    return self._tag_return(dp.get_pooled(ep, timeout_ms),
+                                            dp.return_pooled)
+                if ctype == "short":
+                    return dp.connect_short(ep, timeout_ms)
+                return dp.get_or_connect(ep, timeout_ms)
         # connection-scoped protocols (grpc/redis/thrift/...) can't share a
         # socket with each other or with frame protocols — key the shared
         # map by the protocol itself
         signature = (self._protocol.name
                      if hasattr(self._protocol, "issue_request") else "")
-        return self._socket_map.get_or_create(
-            ep, connect_timeout=self.options.connect_timeout_ms / 1000.0,
+        sm = self._socket_map
+        if ctype == "pooled":
+            return self._tag_return(
+                sm.get_pooled(ep, connect_timeout=timeout_ms / 1000.0,
+                              signature=signature,
+                              ssl_options=self.options.ssl),
+                sm.return_pooled)
+        if ctype == "short":
+            return sm.create_short(
+                ep, connect_timeout=timeout_ms / 1000.0,
+                signature=signature, ssl_options=self.options.ssl)
+        return sm.get_or_create(
+            ep, connect_timeout=timeout_ms / 1000.0,
             signature=signature, ssl_options=self.options.ssl,
         )
+
+    @staticmethod
+    def _tag_return(sock, return_fn):
+        sock._brpc_pool_return = return_fn
+        return sock
+
+    @staticmethod
+    def _release_socket(sock, reusable: bool) -> None:
+        """End-of-RPC hand-back for pooled/short checkouts (no-op for
+        single-type shared sockets)."""
+        if sock is None:
+            return
+        if getattr(sock, "_brpc_short", False):
+            sock._brpc_short = False
+            if not sock.failed:
+                sock.close()
+            return
+        ret = getattr(sock, "_brpc_pool_return", None)
+        if ret is not None and getattr(sock, "_brpc_pool_key", None) \
+                is not None:
+            ret(sock, reusable)
 
     def _on_rpc_end(self, cntl: Controller) -> None:
         self.latency_recorder.record(cntl.latency_us)
@@ -303,14 +356,17 @@ class Channel:
         retries = 0
         code = errors.OK
         text = ""
-        sock = self._fast_sock  # single-remote cache; lb paths re-select
+        single = self.options.connection_type == "single"
+        # single-remote cache; lb and pooled/short paths re-select
+        sock = self._fast_sock if single else None
         rec = None
         reusable = True  # rec may return to the TLS pool (not abandoned)
         while True:
             try:
                 if sock is None or sock.failed:
                     sock = self._select_socket(cntl)
-                    if self._lb is None and isinstance(sock, NativeSocket):
+                    if single and self._lb is None \
+                            and isinstance(sock, NativeSocket):
                         self._fast_sock = sock
             except errors.SelectError as e:
                 code, text = e.code, str(e)
@@ -321,6 +377,9 @@ class Channel:
                 sock = None
             else:
                 if not isinstance(sock, NativeSocket):
+                    # nothing was sent: a pooled/short checkout goes
+                    # straight back (the full pipeline re-selects)
+                    self._release_socket(sock, True)
                     if cntl is None and span is not None:
                         cntl = Controller()
                     if cntl is not None:
@@ -376,7 +435,10 @@ class Channel:
                 code, text = errors.OK, ""
                 if rec is not None:
                     rec.event.clear()
-                if self._lb is not None:
+                if sock is not None and not single:
+                    self._release_socket(sock, False)  # ambiguous checkout
+                    sock = None
+                elif self._lb is not None:
                     sock = None  # LB channels re-pick per attempt
                 continue
             break
@@ -395,6 +457,8 @@ class Channel:
                 code, text = errors.ERESPONSE, f"parse response: {e}"
         if rec is not None and reusable:
             _put_rec(rec)
+        if not single:
+            self._release_socket(sock, code == errors.OK)
         self.latency_recorder.record(latency_us)
         if span is not None:
             span.request_size = len(payload) + len(att)
@@ -562,11 +626,13 @@ class _AsyncFastCall:
                                                    on_flusher_thread)
 
         ch = self.channel
-        sock = ch._fast_sock
+        single = ch.options.connection_type == "single"
+        sock = ch._fast_sock if single else None
         try:
             if sock is None or sock.failed or ch._lb is not None:
                 sock = ch._select_socket(self.cntl)
-                if ch._lb is None and isinstance(sock, NativeSocket):
+                if single and ch._lb is None \
+                        and isinstance(sock, NativeSocket):
                     ch._fast_sock = sock
         except errors.SelectError as e:
             self._finalize(e.code, str(e))
@@ -574,6 +640,7 @@ class _AsyncFastCall:
         except Exception as e:
             return self._retry_or_finalize(errors.EHOSTDOWN, str(e))
         if not isinstance(sock, NativeSocket):
+            ch._release_socket(sock, True)  # unused checkout goes back
             if self.retries == 0:
                 return None
             self._finalize(errors.EHOSTDOWN, "server set changed lanes")
@@ -614,6 +681,10 @@ class _AsyncFastCall:
         if code in errors.DEFAULT_RETRYABLE and self.retries < self.max_retry \
                 and (not self.deadline or _time.monotonic() < self.deadline):
             self.retries += 1
+            if self.sock is not None \
+                    and self.channel.options.connection_type != "single":
+                self.channel._release_socket(self.sock, False)
+                self.sock = None
             from brpc_tpu.rpc.native_transport import on_flusher_thread
 
             if on_flusher_thread():
@@ -675,6 +746,8 @@ class _AsyncFastCall:
         if ch._lb is not None and self.sock is not None \
                 and getattr(self.sock, "remote", None) is not None:
             ch._lb.feedback(self.sock.remote, code, cntl.latency_us)
+        if ch.options.connection_type != "single":
+            ch._release_socket(self.sock, code == errors.OK)
         self.join_ev.set()  # joiners wake before done runs (slow-path order)
         try:
             self.done(cntl)

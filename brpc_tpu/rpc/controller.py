@@ -47,6 +47,10 @@ class Controller:
         self._start_us = 0
         self.latency_us = 0
         self._current_socket = None
+        # pooled/short sockets displaced by retries/backup attempts: their
+        # checkouts are ambiguous and must close at RPC end (a stale
+        # response must never reach the next pooled checkout)
+        self._extra_conn_sockets = []
         self._finished = False
         # server side
         self.is_server_side = False
@@ -136,6 +140,11 @@ class Controller:
             self._error_text = str(e)
             _cid.id_error(cid, errors.EHOSTDOWN)
             return
+        prev = self._current_socket
+        if prev is not None and prev is not sock and (
+                getattr(prev, "_brpc_pool_key", None) is not None
+                or getattr(prev, "_brpc_short", False)):
+            self._extra_conn_sockets.append(prev)
         self._current_socket = sock
         meta = rpc_meta_pb2.RpcMeta()
         meta.request.service_name = self._method.service_name
@@ -268,6 +277,16 @@ class Controller:
             timer_del(self._backup_timer)
         if self._current_socket is not None:
             self._current_socket.remove_pending_id(cid)
+        if self._channel is not None:
+            # pooled/short checkouts end with the RPC: displaced attempts
+            # close; the final socket pools only on a clean OK (backup
+            # hedges leave an abandoned in-flight request behind)
+            for s in self._extra_conn_sockets:
+                self._channel._release_socket(s, False)
+            self._extra_conn_sockets.clear()
+            self._channel._release_socket(
+                self._current_socket,
+                self._error_code == errors.OK and not self._backup_sent)
         self.latency_us = time.perf_counter_ns() // 1000 - self._start_us
         if self._error_code != errors.OK:
             from brpc_tpu import flags as _flags

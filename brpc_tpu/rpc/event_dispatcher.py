@@ -94,9 +94,13 @@ class EventDispatcher:
 
     def disable_write(self, fd: int) -> None:
         with self._lock:
-            r, _ = self._handlers.get(fd, (None, None))
-            if r is None and fd not in self._handlers:
+            if fd not in self._handlers:
                 return
+            r, w = self._handlers[fd]
+            if w is None:
+                return  # write interest never armed: nothing to change
+                # (this is the COMMON case — every inline-drained write
+                # used to pay a wakeup-pipe round trip here, ~2ms each)
             self._handlers[fd] = (r, None)
             if r is None:
                 self._remove_locked(fd)

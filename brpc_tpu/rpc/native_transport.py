@@ -258,6 +258,7 @@ class NativeDataplane:
         self._orphans: Dict[int, list] = {}
         # client connection sharing (the SocketMap of the native world)
         self._conn_map: Dict[Tuple[str, int], NativeSocket] = {}
+        self._conn_pools: Dict[tuple, list] = {}  # pooled free lists
         self._conn_map_lock = threading.Lock()
         self._running = True
         self._proto_trpc = None
@@ -440,7 +441,7 @@ class NativeDataplane:
 
     def get_or_connect(self, ep: EndPoint,
                        timeout_ms: int = 3000) -> NativeSocket:
-        """Shared client connection per endpoint (SocketMap analog)."""
+        """Shared client connection per endpoint ("single" type)."""
         is_tpu = ep.is_tpu()
         key = (ep.host or "127.0.0.1", ep.port,
                ep.device_ordinal if is_tpu else -1)
@@ -457,6 +458,52 @@ class NativeDataplane:
                 return cur
             self._conn_map[key] = sock
             return sock
+
+    # --------------------------------------------- pooled / short conns
+    # (reference channel.h:90-95 connection types on the native lane;
+    # return discipline mirrors rpc/socket_map.py — ambiguous checkouts
+    # close instead of pooling so stale responses can't be replayed)
+    POOL_MAX_IDLE = 32
+
+    def get_pooled(self, ep: EndPoint,
+                   timeout_ms: int = 3000) -> NativeSocket:
+        is_tpu = ep.is_tpu()
+        key = (ep.host or "127.0.0.1", ep.port,
+               ep.device_ordinal if is_tpu else -1)
+        with self._conn_map_lock:
+            pool = self._conn_pools.setdefault(key, [])
+            while pool:
+                sock = pool.pop()
+                if not sock.failed:
+                    sock._brpc_pool_key = key
+                    return sock
+        sock = self.connect_tpu(ep, timeout_ms) if is_tpu \
+            else self.connect(ep, timeout_ms)
+        sock._brpc_pool_key = key
+        return sock
+
+    def return_pooled(self, sock: NativeSocket, reusable: bool) -> None:
+        key = getattr(sock, "_brpc_pool_key", None)
+        if key is None:
+            return
+        sock._brpc_pool_key = None
+        if not reusable or sock.failed:
+            if not sock.failed:
+                sock.close()
+            return
+        with self._conn_map_lock:
+            pool = self._conn_pools.setdefault(key, [])
+            if len(pool) < self.POOL_MAX_IDLE:
+                pool.append(sock)
+                return
+        sock.close()
+
+    def connect_short(self, ep: EndPoint,
+                      timeout_ms: int = 3000) -> NativeSocket:
+        sock = self.connect_tpu(ep, timeout_ms) if ep.is_tpu() \
+            else self.connect(ep, timeout_ms)
+        sock._brpc_short = True
+        return sock
 
     # ------------------------------------------------------------- registry
     def register_socket(self, conn_id: int, sock: NativeSocket) -> None:

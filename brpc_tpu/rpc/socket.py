@@ -59,6 +59,7 @@ class Socket:
         self._write_queue: deque = deque()  # of memoryview
         self._write_queued_bytes = 0
         self._write_registered = False
+        self._write_armed = False  # EPOLLOUT actually armed in epoll
         self._pending_ids: Set[int] = set()
         self._pending_lock = threading.Lock()
         self.in_bytes = 0
@@ -176,7 +177,12 @@ class Socket:
             with self._write_lock:
                 if not self._write_queue:
                     self._write_registered = False
-                    self.dispatcher.disable_write(self.fd)
+                    # only tell the dispatcher when EPOLLOUT was actually
+                    # armed — the common inline-drain path never was, and
+                    # a no-op disable still cost a wakeup round trip
+                    if self._write_armed:
+                        self._write_armed = False
+                        self.dispatcher.disable_write(self.fd)
                     close_now = self._close_after_drain
                     break
                 head = self._write_queue[0]
@@ -187,6 +193,8 @@ class Socket:
                 # TLS renegotiation can want a READ to make write progress;
                 # the read interest is always armed, so re-arming write
                 # covers both cases
+                with self._write_lock:
+                    self._write_armed = True
                 self.dispatcher.enable_write(self.fd, self._on_writable)
                 return
             except OSError as e:
