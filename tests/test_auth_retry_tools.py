@@ -271,6 +271,47 @@ class TestTools:
         finally:
             server.stop(); server.join(timeout=2)
 
+    def test_rpc_press_proto_json_io(self, tmp_path, capsys):
+        """Reference rpc_press parity: runtime .proto compilation
+        (--proto/--inc via protoc), JSON request input, JSON response
+        output, lb over a naming url, pooled connections, attachments."""
+        sys.path.insert(0, "tools")
+        from tools import rpc_press  # noqa
+
+        proto = tmp_path / "press_echo.proto"
+        proto.write_text(
+            'syntax = "proto3";\n'
+            "package press.test;\n"
+            "message Req { string message = 1; bytes payload = 2;\n"
+            "  int32 sleep_us = 3; }\n"
+            "message Resp { string message = 1; bytes payload = 2; }\n"
+            "service EchoService { rpc Echo(Req) returns (Resp); }\n")
+        inp = tmp_path / "reqs.json"
+        inp.write_text('{"message": "a"}\n{"message": "b"}\n')
+        outp = tmp_path / "resps.json"
+        server, impl = start_server()
+        try:
+            rc = rpc_press.main([
+                "--server", f"list://{server.listen_endpoint()}",
+                "--lb-policy", "rr",
+                "--proto", str(proto),
+                "--full-method", "press.test.EchoService.Echo",
+                "--input", str(inp), "--output", str(outp),
+                "--connection-type", "pooled",
+                "--attachment-size", "64",
+                "--qps", "200", "--duration", "0.5", "--quiet"])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "latency_p99_us" in out
+            lines = [l for l in outp.read_text().splitlines() if l.strip()]
+            assert len(lines) > 10
+            import json as _json
+
+            msgs = {_json.loads(l)["message"] for l in lines[:20]}
+            assert msgs <= {"a", "b"} and msgs
+        finally:
+            server.stop(); server.join(timeout=2)
+
     def test_rpc_dump_then_replay(self, tmp_path, capsys):
         from tools import rpc_replay
 

@@ -53,6 +53,14 @@ class TestProfiling:
         ep = str(server.listen_endpoint())
         r = http_fetch(ep, path="/hotspots")
         assert b"/hotspots/cpu" in r.body
+        assert b"/hotspots/flame" in r.body
+
+    def test_flame_view(self, server):
+        ep = str(server.listen_endpoint())
+        r = http_fetch(ep, path="/hotspots/flame?seconds=0.3", timeout=10)
+        assert r.status == 200
+        assert b"samples over" in r.body
+        assert b'class="f"' in r.body  # nested frame divs rendered
 
     def test_pprof_endpoints(self, server):
         ep = str(server.listen_endpoint())

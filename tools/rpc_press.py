@@ -25,19 +25,87 @@ from brpc_tpu.rpc import Channel, ChannelOptions, Controller, MethodDescriptor
 from brpc_tpu.rpc.channel import RawMessage
 
 
+def load_proto_method(proto_path: str, incs: str, full_method: str):
+    """Compile a user .proto with protoc and resolve pkg.Service.Method —
+    the reference presses arbitrary services the same way (its
+    pb_util.cpp imports the proto at runtime)."""
+    import subprocess
+    import tempfile
+
+    from google.protobuf import descriptor_pb2, descriptor_pool
+    from google.protobuf import message_factory
+
+    with tempfile.NamedTemporaryFile(suffix=".ds", delete=False) as tmp:
+        ds_path = tmp.name
+    inc_args = []
+    for inc in (incs or "").split(";"):
+        if inc:
+            inc_args += ["-I", inc]
+    inc_args += ["-I", os.path.dirname(os.path.abspath(proto_path)) or "."]
+    cmd = ["protoc", *inc_args, "--include_imports",
+           f"--descriptor_set_out={ds_path}", proto_path]
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    if r.returncode != 0:
+        raise SystemExit(f"protoc failed: {r.stderr.strip()}")
+    with open(ds_path, "rb") as f:
+        fds = descriptor_pb2.FileDescriptorSet.FromString(f.read())
+    os.unlink(ds_path)
+    pool = descriptor_pool.DescriptorPool()
+    for fd in fds.file:
+        pool.Add(fd)
+    svc_full, _, meth_name = full_method.rpartition(".")
+    svc = pool.FindServiceByName(svc_full)
+    mdesc = svc.methods_by_name[meth_name]
+    md = MethodDescriptor(
+        service_name=svc.name, method_name=meth_name,
+        request_class=message_factory.GetMessageClass(
+            pool.FindMessageTypeByName(mdesc.input_type.full_name)),
+        response_class=message_factory.GetMessageClass(
+            pool.FindMessageTypeByName(mdesc.output_type.full_name)))
+    return md
+
+
+def load_input_requests(path: str, request_class):
+    """JSON requests (one object per line, or a top-level JSON list),
+    converted through the json2pb bridge — reference json_loader.cpp."""
+    import json
+
+    from brpc_tpu.json2pb import json_to_pb
+
+    with open(path) as f:
+        text = f.read().strip()
+    if text.startswith("["):
+        docs = [json.dumps(d) for d in json.loads(text)]
+    else:
+        docs = [line for line in text.splitlines() if line.strip()]
+    if not docs:
+        raise SystemExit(f"--input {path}: no JSON requests found")
+    return [json_to_pb(doc, request_class) for doc in docs]
+
+
 def build_method(args) -> tuple:
+    if args.proto:
+        md = load_proto_method(args.proto, args.inc, args.full_method
+                               or f"{args.service}.{args.method}")
+        if args.input:
+            reqs = load_input_requests(args.input, md.request_class)
+        else:
+            reqs = [md.request_class()]
+        return md, reqs
     if args.body_file:
         with open(args.body_file, "rb") as f:
             body = f.read()
         md = MethodDescriptor(args.service, args.method,
                               request_class=None, response_class=RawMessage)
-        return md, RawMessage(body)
+        return md, [RawMessage(body)]
     from brpc_tpu.proto import echo_pb2
 
     md = MethodDescriptor.from_pb(
         echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
         .methods_by_name["Echo"])
-    return md, echo_pb2.EchoRequest(message="x" * args.payload_size)
+    if args.input:
+        return md, load_input_requests(args.input, md.request_class)
+    return md, [echo_pb2.EchoRequest(message="x" * args.payload_size)]
 
 
 def main(argv=None) -> int:
@@ -52,17 +120,47 @@ def main(argv=None) -> int:
     p.add_argument("--protocol", default="trpc_std")
     p.add_argument("--service", default="EchoService")
     p.add_argument("--method", default="Echo")
+    p.add_argument("--full-method", default=None,
+                   help="pkg.Service.Method (with --proto)")
     p.add_argument("--payload-size", type=int, default=16)
     p.add_argument("--body-file", default=None,
                    help="raw serialized request body")
+    p.add_argument("--proto", default=None,
+                   help="user .proto file (compiled via protoc at runtime)")
+    p.add_argument("--inc", default="",
+                   help="include paths for --proto, ';'-separated")
+    p.add_argument("--input", default=None,
+                   help="JSON request file (one object per line or a list;"
+                        " cycled round-robin)")
+    p.add_argument("--output", default=None,
+                   help="write response JSONs here (one per line)")
+    p.add_argument("--pretty", action="store_true",
+                   help="pretty-print --output jsons")
+    p.add_argument("--lb-policy", default=None,
+                   help="load balancer (rr/random/wrr/la/c_hash); --server"
+                        " becomes a naming url, e.g. list://a:1,b:2")
+    p.add_argument("--connection-type", default="single",
+                   choices=("single", "pooled", "short"))
+    p.add_argument("--attachment-size", type=int, default=0,
+                   help="bytes of attachment carried with every request")
+    p.add_argument("--compress", default="none",
+                   choices=("none", "gzip", "zlib"))
     p.add_argument("--quiet", action="store_true")
     args = p.parse_args(argv)
 
+    from brpc_tpu.policy import compress as _compress
+
+    ct = {"none": _compress.COMPRESS_NONE, "gzip": _compress.COMPRESS_GZIP,
+          "zlib": _compress.COMPRESS_ZLIB}[args.compress]
     channel = Channel(ChannelOptions(
         timeout_ms=args.timeout_ms, protocol=args.protocol,
-        max_retry=0)).init(args.server)
-    method, request = build_method(args)
+        connection_type=args.connection_type, compress_type=ct,
+        max_retry=0)).init(args.server, args.lb_policy)
+    method, requests = build_method(args)
+    attachment = b"\xab" * args.attachment_size
 
+    out_f = open(args.output, "w") if args.output else None
+    out_lock = threading.Lock()
     recorder = LatencyRecorder()
     sent = [0]
     errors_count = [0]
@@ -78,6 +176,15 @@ def main(argv=None) -> int:
             errors_count[0] += 1
         else:
             recorder.record(cntl.latency_us)
+            if out_f is not None and cntl.response is not None:
+                from brpc_tpu.json2pb import pb_to_json
+
+                try:
+                    doc = pb_to_json(cntl.response, pretty=args.pretty)
+                except Exception:
+                    doc = "{}"
+                with out_lock:
+                    out_f.write(doc + "\n")
         inflight.release()
         with pending_lock:
             pending[0] -= 1
@@ -97,9 +204,15 @@ def main(argv=None) -> int:
         inflight.acquire()
         with pending_lock:
             pending[0] += 1
+        request = requests[sent[0] % len(requests)]
         sent[0] += 1
         resp = method.response_class() if method.response_class else None
-        channel.call_method(method, request, response=resp, done=on_done)
+        cntl = None
+        if attachment:
+            cntl = Controller()
+            cntl.request_attachment = attachment
+        channel.call_method(method, request, response=resp, controller=cntl,
+                            done=on_done)
         now = time.monotonic()
         if not args.quiet and now - last_report >= 1.0:
             last_report = now
@@ -113,6 +226,8 @@ def main(argv=None) -> int:
             done_all.set()
     done_all.wait(timeout=args.timeout_ms / 1000.0 + 1.0)
 
+    if out_f is not None:
+        out_f.close()
     total = recorder.count()
     print(f"sent {sent[0]} ok {total} errors {errors_count[0]}")
     print(f"latency_avg_us {recorder.latency():.1f}")
