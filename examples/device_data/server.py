@@ -1,0 +1,35 @@
+"""Device-resident payload server: this process owns the chip; clients
+orchestrate HBM-resident data over any transport (tpu:// shm tunnel here).
+
+    python examples/device_data/server.py [--listen tpu://127.0.0.1:8300/0]
+"""
+
+import argparse
+import signal
+import sys
+
+from brpc_tpu.rpc import Server, ServerOptions
+from brpc_tpu.tpu.device_lane import DeviceDataService
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--listen", default="tpu://127.0.0.1:8300/0")
+    args = ap.parse_args(argv)
+    server = Server(ServerOptions(native_dataplane=True))
+    server.add_service(DeviceDataService())
+    server.start(args.listen)
+    print(f"DeviceDataService on {server.listen_endpoint()} "
+          f"(dashboard: /status /vars /rpcz)", flush=True)
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    while not stop:
+        signal.pause()
+    server.stop()
+    server.join()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

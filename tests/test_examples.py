@@ -105,6 +105,31 @@ class TestSingleFileExamples:
                          ["--sizes", "4096,65536", "-n", "4"])
         assert "MB/s" in out
 
+    def test_device_data(self):
+        srv = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "examples", "device_data",
+                                          "server.py"),
+             "--listen", "tpu://127.0.0.1:0/0"],
+            env=ENV, stdout=subprocess.PIPE, text=True)
+        try:
+            line = srv.stdout.readline()
+            addr = line.split(" on ", 1)[1].split(" ")[0].strip()
+            client = subprocess.run(
+                [sys.executable, os.path.join(REPO, "examples",
+                                              "device_data", "client.py"),
+                 "--server", addr, "--mb", "1", "--copies", "3",
+                 "--pump-rounds", "2"],
+                env=ENV, capture_output=True, text=True, timeout=120)
+            assert client.returncode == 0, client.stdout + client.stderr
+            assert "content verified" in client.stdout
+            assert "checksum=" in client.stdout
+        finally:
+            srv.terminate()
+            try:
+                srv.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                srv.kill()
+
     def test_transport_sweep(self):
         # bench_server prints LISTEN and serves until stdin closes
         srv = subprocess.Popen(

@@ -439,6 +439,63 @@ class TestTunnelGarbageResilience:
             server.stop()
             server.join()
 
+    def test_malformed_zero_copy_data_frames(self):
+        """The zero-copy DATA route parses peer-controlled block refs and
+        an embedded TRPC header straight out of pool memory — hostile
+        geometries (bad indices, lying lengths, split headers, random
+        fuzz) must fail ONLY the offending conn, never the process or
+        innocent tunnels (round-3 surface; reference trust model is
+        rdma_endpoint.cpp's, ours must still not crash)."""
+        import random
+        import socket as _socket
+        import struct as _struct
+
+        server = Server(ServerOptions(native_dataplane=True))
+        server.add_service(EchoImpl())
+        server.start("tpu://127.0.0.1:0/0")
+        server.register_native_echo("EchoService", "Echo")
+        try:
+            ep = server.listen_endpoint()
+            stub = _stub(server, native=True, timeout_ms=10000)
+            stub.Echo(echo_pb2.EchoRequest(message="before"))
+
+            def data_frame(body: bytes) -> bytes:
+                return b"TPUC\x03" + _struct.pack("!I", len(body)) + body
+
+            def hello() -> bytes:
+                j = (b'{"v": 1, "pool": "nonexistent_pool_zz", '
+                     b'"bs": 4096, "bc": 4, "ordinal": 0, "pid": 1}')
+                return b"TPUC\x01" + _struct.pack("!I", len(j)) + j
+
+            rng = random.Random(7)
+            attacks = [
+                # block index beyond the pool
+                _struct.pack("!II", 0, 1) + _struct.pack("!II", 9999, 64),
+                # length beyond the block size
+                _struct.pack("!II", 0, 1) + _struct.pack("!II", 0, 1 << 30),
+                # nsegs lies about the body size
+                _struct.pack("!II", 0, 4096),
+                # zero-length segment
+                _struct.pack("!II", 0, 2) + _struct.pack("!II", 0, 0) * 2,
+            ] + [bytes(rng.randrange(256) for _ in range(rng.randrange(
+                1, 128))) for _ in range(20)]
+            for body in attacks:
+                with _socket.create_connection((ep.host, ep.port),
+                                               timeout=5) as s:
+                    s.sendall(hello())
+                    s.sendall(data_frame(body))
+                    s.settimeout(1)
+                    try:
+                        while s.recv(4096):
+                            pass
+                    except (TimeoutError, OSError):
+                        pass
+            r = stub.Echo(echo_pb2.EchoRequest(message="after"))
+            assert r.message == "after"  # engine + real tunnel survived
+        finally:
+            server.stop()
+            server.join()
+
 
 class TestShutdownQuiesce:
     """dp_rt_shutdown must quiesce TPUC sender workers mid-traffic
