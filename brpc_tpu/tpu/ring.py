@@ -50,10 +50,120 @@ def _block_attend(q, k, v, o, m, l, mask):
     return o_new, m_new, l_new
 
 
+def _make_ring_flash(axis, n, fwd, causal, block_q, block_k, vaxes,
+                     interp):
+    """Differentiable ring-flash attention, shard-local (call inside the
+    shard_map). Forward threads (m, l, acc) through the carry-form flash
+    kernel across KV ring hops; backward is its OWN ring: each hop runs
+    the Pallas flash-backward kernels (pallas_ops._flash_bwd_bhsd) on the
+    visiting KV block, and the dk/dv accumulators travel WITH the block
+    around the ring so after n hops every gradient block arrives back at
+    its home device. The custom_vjp means AD never differentiates through
+    a pallas_call or the fwd fori_loop."""
+    from brpc_tpu.tpu.pallas_ops import (flash_attention_carry,
+                                         _fit_block, _flash_bwd_bhsd,
+                                         _flash_delta)
+    vma = vaxes or None
+
+    def _fwd_impl(q, k, v):
+        B, sq, H, D = q.shape
+        my = lax.axis_index(axis)
+        q_start = my * sq
+        qt = q.transpose(0, 2, 1, 3)           # [B,H,sq,D], kernel layout
+        m0 = lax.pvary(jnp.full((B, H, sq, 1), NEG_INF, jnp.float32),
+                       vaxes)
+        l0 = lax.pvary(jnp.zeros((B, H, sq, 1), jnp.float32), vaxes)
+        a0 = lax.pvary(jnp.zeros((B, H, sq, D), jnp.float32), vaxes)
+
+        def step(i, carry):
+            k_cur, v_cur, at, mt, lt = carry
+            src = (my - i) % n
+            sk = k_cur.shape[1]
+            k_start = src * sk
+
+            def one_head(q1, k1, v1, m1, l1, a1):
+                return flash_attention_carry(
+                    q1, k1, v1, m1, l1, a1, q_start, k_start,
+                    causal=causal, block_q=_fit_block(sq, block_q),
+                    block_k=_fit_block(sk, block_k), vma=vma)
+
+            kt = k_cur.transpose(0, 2, 1, 3)
+            vt = v_cur.transpose(0, 2, 1, 3)
+            mt, lt, at = jax.vmap(jax.vmap(one_head))(qt, kt, vt, mt, lt,
+                                                      at)
+            return (lax.ppermute(k_cur, axis, fwd),
+                    lax.ppermute(v_cur, axis, fwd), at, mt, lt)
+
+        (_, _, at, mt, lt) = lax.fori_loop(0, n, step, (k, v, a0, m0, l0))
+        l_safe = jnp.where(lt == 0, 1.0, lt)
+        out_bhsd = (at / l_safe).astype(q.dtype)
+        lse = jnp.where(lt == 0, NEG_INF, mt + jnp.log(l_safe))
+        return out_bhsd, lse
+
+    def _bwd_impl(q, k, v, out_bhsd, lse, do):
+        B, sq, H, D = q.shape
+        sk0 = k.shape[1]
+        my = lax.axis_index(axis)
+        q_start = my * sq
+        qb = q.transpose(0, 2, 1, 3).reshape(B * H, sq, D)
+        dob = do.transpose(0, 2, 1, 3).reshape(B * H, sq, D)
+        lseb = lse.reshape(B * H, sq, 1)
+        # loop-invariant: delta depends only on (o, do), computed once
+        deltab = _flash_delta(out_bhsd.reshape(B * H, sq, D), dob)
+        dq0 = lax.pvary(jnp.zeros((B * H, sq, D), jnp.float32), vaxes)
+        dk0 = lax.pvary(jnp.zeros((B, sk0, H, D), jnp.float32), vaxes)
+        dv0 = lax.pvary(jnp.zeros((B, sk0, H, D), jnp.float32), vaxes)
+
+        def step(i, carry):
+            k_cur, v_cur, dk_cur, dv_cur, dq_acc = carry
+            src = (my - i) % n
+            sk = k_cur.shape[1]
+            k_start = src * sk
+            kb = k_cur.transpose(0, 2, 1, 3).reshape(B * H, sk, D)
+            vb = v_cur.transpose(0, 2, 1, 3).reshape(B * H, sk, D)
+            dq_b, dk_b, dv_b = _flash_bwd_bhsd(
+                qb, kb, vb, lseb, dob, deltab, q_start, k_start, causal,
+                _fit_block(sq, block_q), _fit_block(sk, block_k), interp,
+                vma=vma)
+            dq_acc = dq_acc + dq_b.astype(jnp.float32)
+            dk_cur = dk_cur + dk_b.reshape(B, H, sk, D).transpose(
+                0, 2, 1, 3).astype(jnp.float32)
+            dv_cur = dv_cur + dv_b.reshape(B, H, sk, D).transpose(
+                0, 2, 1, 3).astype(jnp.float32)
+            # the kv block AND its gradient accumulators rotate together;
+            # after n hops both are home
+            return (lax.ppermute(k_cur, axis, fwd),
+                    lax.ppermute(v_cur, axis, fwd),
+                    lax.ppermute(dk_cur, axis, fwd),
+                    lax.ppermute(dv_cur, axis, fwd), dq_acc)
+
+        (_, _, dk, dv, dq) = lax.fori_loop(0, n, step,
+                                           (k, v, dk0, dv0, dq0))
+        dq_out = dq.reshape(B, H, sq, D).transpose(0, 2, 1, 3)
+        return (dq_out.astype(q.dtype), dk.astype(k.dtype),
+                dv.astype(v.dtype))
+
+    @jax.custom_vjp
+    def rf(q, k, v):
+        out_bhsd, _ = _fwd_impl(q, k, v)
+        return out_bhsd.transpose(0, 2, 1, 3)
+
+    def rf_fwd(q, k, v):
+        out_bhsd, lse = _fwd_impl(q, k, v)
+        return out_bhsd.transpose(0, 2, 1, 3), (q, k, v, out_bhsd, lse)
+
+    def rf_bwd(res, do):
+        q, k, v, out_bhsd, lse = res
+        return _bwd_impl(q, k, v, out_bhsd, lse, do)
+
+    rf.defvjp(rf_fwd, rf_bwd)
+    return rf
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis: str, causal: bool = False,
                    batch_axis: str = None, head_axis: str = None,
-                   use_flash: bool = False, block_q: int = 128,
-                   block_k: int = 128):
+                   use_flash: bool = False, block_q: int = 512,
+                   block_k: int = 1024):
     """Attention over sequence-sharded q/k/v: [B, S, H, D] sharded on S.
 
     Composes with data parallelism (batch_axis shards B) and tensor
@@ -77,58 +187,28 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str, causal: bool = False,
     # the kernel's vma= annotation and keeps the check
     check_vma = not (use_flash and jax.default_backend() != "tpu")
 
+    interp = jax.default_backend() != "tpu"
+
     @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
              out_specs=spec, check_vma=check_vma)
     def _f(q, k, v):
         B, sq, H, D = q.shape
+        vaxes = tuple(a for a in (batch_axis, axis, head_axis) if a)
+
+        if use_flash:
+            rf = _make_ring_flash(axis, n, fwd, causal, block_q, block_k,
+                                  vaxes, interp)
+            return rf(q, k, v)
+
         my = lax.axis_index(axis)
         o = jnp.zeros_like(q, dtype=jnp.float32)
         # pvary: the accumulators become varying over every sharded axis
         # inside the loop, so their initial values must carry the same
         # varying-axes type
-        vaxes = tuple(a for a in (batch_axis, axis, head_axis) if a)
         m = lax.pvary(jnp.full((B, H, sq), NEG_INF, dtype=jnp.float32),
                       vaxes)
         l = lax.pvary(jnp.zeros((B, H, sq), dtype=jnp.float32), vaxes)
         qf = q.astype(jnp.float32)
-
-        if use_flash:
-            from brpc_tpu.tpu.pallas_ops import flash_attention_carry
-
-            # kernel layout [B,H,sq,D] held ACROSS the loop: the q
-            # transpose happens once (a fori_loop body re-executes every
-            # hop — loop-invariant work in it is n-1 wasted relayouts)
-            qt = qf.transpose(0, 2, 1, 3)
-            q_start = my * sq
-
-            def step_flash(i, carry):
-                k_cur, v_cur, at, mt, lt = carry
-                src = (my - i) % n
-                sk = k_cur.shape[1]
-                k_start = src * sk
-
-                def one_head(q1, k1, v1, m1, l1, a1):
-                    return flash_attention_carry(
-                        q1, k1, v1, m1, l1, a1, q_start, k_start,
-                        causal=causal, block_q=min(block_q, sq),
-                        block_k=min(block_k, sk), vma=vaxes)
-
-                kt = k_cur.astype(jnp.float32).transpose(0, 2, 1, 3)
-                vt = v_cur.astype(jnp.float32).transpose(0, 2, 1, 3)
-                mt, lt, at = jax.vmap(jax.vmap(one_head))(
-                    qt, kt, vt, mt, lt, at)
-                k_nxt = lax.ppermute(k_cur, axis, fwd)
-                v_nxt = lax.ppermute(v_cur, axis, fwd)
-                return (k_nxt, v_nxt, at, mt, lt)
-
-            at0 = jnp.zeros((B, H, sq, D), dtype=jnp.float32)
-            at0 = lax.pvary(at0, vaxes)
-            (_, _, at, mt, lt) = lax.fori_loop(
-                0, n, step_flash,
-                (k, v, at0, m[..., None], l[..., None]))
-            l_safe = jnp.where(lt == 0, 1.0, lt)
-            out = (at / l_safe).transpose(0, 2, 1, 3)
-            return out.astype(q.dtype)
 
         def step(i, carry):
             k_cur, v_cur, o, m, l = carry

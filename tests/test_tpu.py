@@ -148,6 +148,34 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_flash_ring_gradients_match_reference(self, causal):
+        # VERDICT r3 #3: the ring-flash path must be trainable — its
+        # custom VJP runs the Pallas flash-backward kernels per hop and
+        # rotates dk/dv home around the ring
+        m = meshlib.make_mesh({"dp": 2, "sp": 2, "tp": 2})
+        rng = np.random.default_rng(7)
+        B, S, H, D = 2, 32, 4, 16
+        q = jnp.asarray(rng.normal(size=(B, S, H, D)), dtype=jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, S, H, D)), dtype=jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, S, H, D)), dtype=jnp.float32)
+
+        def loss_flash(q, k, v):
+            o = ring_attention(q, k, v, m, "sp", causal=causal,
+                               batch_axis="dp", head_axis="tp",
+                               use_flash=True, block_q=16, block_k=16)
+            return jnp.sum(jnp.sin(o))
+
+        def loss_ref(q, k, v):
+            return jnp.sum(jnp.sin(
+                full_attention_reference(q, k, v, causal=causal)))
+
+        g = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-5)
+
 
 class TestPallasOps:
     def test_rmsnorm_matches_reference(self):
@@ -169,6 +197,23 @@ class TestPallasOps:
         np.testing.assert_allclose(
             np.asarray(rmsnorm(x, w, block_rows=4)),
             np.asarray(rmsnorm_reference(x, w)), rtol=1e-5)
+
+    def test_rmsnorm_gradients_match_reference(self):
+        from brpc_tpu.tpu.pallas_ops import rmsnorm, rmsnorm_reference
+
+        rng = np.random.default_rng(9)
+        x = jnp.asarray(rng.normal(size=(4, 32, 128)), dtype=jnp.float32)
+        w = jnp.asarray(rng.normal(size=(128,)), dtype=jnp.float32)
+        gx, gw = jax.grad(
+            lambda x, w: jnp.sum(jnp.sin(rmsnorm(x, w))),
+            argnums=(0, 1))(x, w)
+        rx, rw = jax.grad(
+            lambda x, w: jnp.sum(jnp.sin(rmsnorm_reference(x, w))),
+            argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
+                                   rtol=1e-4, atol=1e-5)
 
 
 class TestTpuSocket:
@@ -326,6 +371,39 @@ class TestFlashAttention:
             flash_attention(q, q, q, block_q=64, block_k=64,
                             interpret=True)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_mha_gradients_match_reference(self, causal):
+        # the Pallas backward kernels (dq / dkv) against AD through the
+        # O(S^2) reference
+        import jax
+
+        from brpc_tpu.tpu.pallas_ops import (attention_reference,
+                                             flash_attention_mha)
+
+        key = jax.random.PRNGKey(5)
+        B, H, S, D = 2, 3, 128, 32
+        q, k, v = (jax.random.normal(kk, (B, H, S, D), dtype=jnp.float32)
+                   for kk in jax.random.split(key, 3))
+
+        def ref(q, k, v):
+            f = lambda q1, k1, v1: attention_reference(q1, k1, v1,
+                                                       causal=causal)
+            return jax.vmap(jax.vmap(f))(q, k, v)
+
+        def loss_f(q, k, v):
+            return jnp.sum(jnp.sin(flash_attention_mha(
+                q, k, v, causal=causal, block_q=64, block_k=64,
+                interpret=True)))
+
+        def loss_r(q, k, v):
+            return jnp.sum(jnp.sin(ref(q, k, v)))
+
+        g = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-5)
+
     def test_flash_attention_on_hardware(self):
         """Exercise the NATIVE Mosaic lowering (scratch shapes, tiling) —
         interpret mode can hide hardware constraints. bf16 MXU matmuls
@@ -353,7 +431,8 @@ class TestFlashInModel:
         from brpc_tpu.tpu import train
 
         cfg_ref = train.ModelConfig(vocab=64, d_model=64, n_heads=2,
-                                    n_layers=2, d_ff=128, max_seq=128)
+                                    n_layers=2, d_ff=128, max_seq=128,
+                                    use_flash_attention=False)
         cfg_flash = train.ModelConfig(vocab=64, d_model=64, n_heads=2,
                                       n_layers=2, d_ff=128, max_seq=128,
                                       use_flash_attention=True)
@@ -363,6 +442,31 @@ class TestFlashInModel:
         out = train.forward(params, tokens, cfg_flash)
         assert jnp.allclose(out, ref, atol=3e-3), float(
             jnp.abs(out - ref).max())
+
+    def test_train_step_grads_through_flash(self):
+        # the default config is kernels-on (VERDICT r3 #3): a full
+        # value_and_grad train step must flow through the Pallas custom
+        # VJPs and match the XLA-attention baseline's gradients
+        import jax
+
+        from brpc_tpu.tpu import train
+
+        base = dict(vocab=64, d_model=64, n_heads=2, n_layers=2,
+                    d_ff=128, max_seq=128)
+        cfg_on = train.ModelConfig(**base, use_flash_attention=True)
+        cfg_off = train.ModelConfig(**base, use_flash_attention=False)
+        params = train.init_params(jax.random.PRNGKey(0), cfg_on)
+        batch = train.demo_batch(jax.random.PRNGKey(1), cfg_on, 2, 128)
+        loss_on, g_on = jax.value_and_grad(train.loss_fn)(params, batch,
+                                                          cfg_on)
+        loss_off, g_off = jax.value_and_grad(train.loss_fn)(params, batch,
+                                                            cfg_off)
+        assert jnp.allclose(loss_on, loss_off, rtol=1e-4)
+        flat_on = jax.tree_util.tree_leaves(g_on)
+        flat_off = jax.tree_util.tree_leaves(g_off)
+        for a, b in zip(flat_on, flat_off):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=5e-4)
 
 
 class TestFusedXent:

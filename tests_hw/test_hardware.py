@@ -31,6 +31,33 @@ class TestKernelsOnChip:
                 np.asarray(out, dtype=np.float32),
                 np.asarray(ref, dtype=np.float32), rtol=0.1, atol=0.06)
 
+    def test_flash_mha_bwd_on_chip(self, tpu_device):
+        # the Pallas backward kernels under the NATIVE Mosaic lowering;
+        # oracle = AD through the O(S^2) reference in f32
+        from brpc_tpu.tpu.pallas_ops import (attention_reference,
+                                             flash_attention_mha)
+
+        rng = np.random.default_rng(7)
+        B, H, S, D = 2, 2, 512, 128
+        q = jnp.asarray(rng.normal(size=(B, H, S, D)), dtype=jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, H, S, D)), dtype=jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, H, S, D)), dtype=jnp.float32)
+
+        def ref(q, k, v):
+            f = lambda q1, k1, v1: attention_reference(q1, k1, v1,
+                                                       causal=True)
+            return jax.vmap(jax.vmap(f))(q, k, v)
+
+        g = jax.grad(lambda q, k, v: jnp.sum(jnp.sin(flash_attention_mha(
+            q, k, v, causal=True, interpret=False))), argnums=(0, 1, 2))(
+                q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.sum(jnp.sin(ref(q, k, v))),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            # bf16 MXU tiles inside the kernel vs f32 XLA reference
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=0.1, atol=0.05)
+
     def test_flash_carry_matches_one_shot(self, tpu_device):
         # carry form seeded with the identity state + one pass + normalize
         # == the one-shot kernel (the ring-hop contract)

@@ -1,4 +1,4 @@
-"""Kernel numbers on the real chip for BENCH_r03 (VERDICT r2 #6).
+"""Kernel numbers on the real chip for BENCH (VERDICT r3 #2/#3).
 
 Run standalone (owns the chip):
 
@@ -7,7 +7,12 @@ Run standalone (owns the chip):
 Timing methodology: marginal cost between two round counts inside ONE
 compiled loop (docs/round3-notes.md — completion signals through the axon
 relay are unreliable, so every measurement forces a dependent fetch and
-amortizes the relay's fixed sync cost out via the slope).
+amortizes the relay's fixed sync cost out via the slope). Round 4 fix
+(docs/round4-notes.md): the loop body CHAINS the op (x_{i+1} = f(x_i))
+instead of perturbing one element of the input — the old `x.at[0,0].add`
+anti-hoisting trick copied the whole input every iteration, which for
+memory-bound kernels silently doubled the true traffic and halved the
+reported bandwidth.
 """
 
 import os
@@ -20,42 +25,45 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 V5E_PEAK_FLOPS = 197e12
 
 
-def _marginal(fn, lo, hi):
-    """Seconds per unit via the (hi - lo) slope; 3 attempts, best."""
+def _marginal(fn, lo, hi, reps=4):
+    """Seconds per unit via the (hi - lo) slope; min over reps (the axon
+    relay adds tens-to-hundreds of ms of sync noise, so work at `hi` must
+    dwarf it and min-filtering matters)."""
     fn(lo)  # compile both
     fn(hi)
-    best = float("inf")
-    for _ in range(3):
+    tls, this = [], []
+    for _ in range(reps):
         t0 = time.perf_counter()
         fn(lo)
-        t_lo = time.perf_counter() - t0
+        tls.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
         fn(hi)
-        t_hi = time.perf_counter() - t0
-        best = min(best, (t_hi - t_lo) / (hi - lo))
-    return max(best, 1e-12)
+        this.append(time.perf_counter() - t0)
+    return max((min(this) - min(tls)) / (hi - lo), 1e-12)
 
 
 def bench_flash_attention():
+    """Forward kernel at the headline shape, then the full differentiable
+    fwd+bwd path (the number the train step actually rides)."""
+    import functools
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from brpc_tpu.tpu.pallas_ops import flash_attention_mha
 
-    B, H, S, D = 4, 8, 2048, 128  # the model-shaped call (vmapped heads)
+    B, H, S, D = 4, 8, 2048, 128
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.normal(size=(B, H, S, D)), dtype=jnp.bfloat16)
     k = jnp.asarray(rng.normal(size=(B, H, S, D)), dtype=jnp.bfloat16)
     v = jnp.asarray(rng.normal(size=(B, H, S, D)), dtype=jnp.bfloat16)
 
-    import functools
-
     @functools.partial(jax.jit, static_argnames=("n",))
     def loop(q, k, v, n: int):
         def body(i, acc):
-            # acc feeds q so the kernel is NOT loop-invariant (XLA would
-            # hoist an identical call out of the loop and "measure" one)
+            # acc feeds q so the kernel is NOT loop-invariant; q is tiny
+            # (8MB) next to the compute, unlike the rmsnorm case
             q2 = q.at[0, 0, 0, 0].add(acc.astype(q.dtype))
             o = flash_attention_mha(q2, k, v, causal=False,
                                     interpret=False)
@@ -66,73 +74,54 @@ def bench_flash_attention():
     def run(n):
         float(jax.device_get(loop(q, k, v, n)))
 
-    # per-call device time is ~ms; the relay's sync noise is tens of ms —
-    # the work delta must dwarf it
     sec = _marginal(run, 64, 512)
     flops = 4.0 * B * H * S * S * D  # QK^T + PV, 2 flops per MAC
     tf = flops / sec / 1e12
-    print(f"# kernel flash_attention B={B} H={H} S={S} D={D}: "
+    print(f"# kernel flash_attention fwd B={B} H={H} S={S} D={D}: "
+          f"{tf:7.2f} TFLOP/s "
+          f"({tf*1e12/V5E_PEAK_FLOPS*100:.1f}% of v5e bf16 peak)",
+          flush=True)
+
+    def f(q, k, v):
+        o = flash_attention_mha(q, k, v, causal=False, interpret=False)
+        return jnp.sum(o.astype(jnp.float32) * 1e-3)
+
+    g = jax.grad(f, argnums=(0, 1, 2))
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def loop_bwd(q, k, v, n: int):
+        def body(i, acc):
+            q2 = q.at[0, 0, 0, 0].add(acc.astype(q.dtype))
+            dq, dk, dv = g(q2, k, v)
+            return acc + (dq[0, 0, 0, 0] + dk[0, 0, 0, 0]
+                          + dv[0, 0, 0, 0]).astype(jnp.float32) * 1e-6
+
+        return jax.lax.fori_loop(0, n, body, jnp.float32(0))
+
+    def run_bwd(n):
+        float(jax.device_get(loop_bwd(q, k, v, n)))
+
+    sec = _marginal(run_bwd, 32, 256)
+    # fwd 2 matmuls + bwd 5 matmuls per (q, k) tile pair
+    flops = 7.0 * 2.0 * B * H * S * S * D
+    tf = flops / sec / 1e12
+    print(f"# kernel flash_attention fwd+bwd (custom-vjp Pallas backward): "
           f"{tf:7.2f} TFLOP/s "
           f"({tf*1e12/V5E_PEAK_FLOPS*100:.1f}% of v5e bf16 peak)",
           flush=True)
     return tf
 
 
-def bench_train_step_mfu():
-    """Single-chip train step of the flagship LM at a matmul-heavy size;
-    MFU = analytic matmul FLOPs / wall / peak."""
-    import jax
-    import jax.numpy as jnp
-
-    from brpc_tpu.tpu import train
-
-    cfg = train.ModelConfig(vocab=16384, d_model=1024, n_heads=16,
-                            n_layers=8, d_ff=4096, max_seq=1024,
-                            dtype=jnp.bfloat16)
-    B, S = 8, 1024
-    params = train.init_params(jax.random.PRNGKey(0), cfg)
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, cfg.vocab)
-
+def bench_rmsnorm():
+    """Chained-carry bandwidth, reported against the measured Mosaic DMA
+    ceiling (a pure-copy Pallas kernel) AND the XLA wire (fused add)."""
     import functools
 
-    targets = jax.random.randint(jax.random.PRNGKey(2), (B, S), 0,
-                                 cfg.vocab)
-
-    @functools.partial(jax.jit, static_argnames=("n",))
-    def steps(params, tokens, n: int):
-        def body(i, p):
-            loss, grads = jax.value_and_grad(train.loss_fn)(
-                p, (tokens, targets), cfg)
-            return jax.tree_util.tree_map(
-                lambda a, g: (a - 1e-4 * g).astype(a.dtype), p, grads)
-
-        return jax.lax.fori_loop(0, n, body, params)
-
-    def run(n):
-        out = steps(params, tokens, n)
-        jax.device_get(jax.tree.leaves(out)[0][:1])  # dependent fetch
-
-    sec = _marginal(run, 1, 4)
-    # analytic matmul FLOPs per fwd+bwd step: 6 * params_in_matmuls * tokens
-    matmul_params = (cfg.n_layers * (cfg.d_model * 3 * cfg.d_model     # qkv
-                                     + cfg.d_model * cfg.d_model       # wo
-                                     + 2 * cfg.d_model * cfg.d_ff)     # mlp
-                     + cfg.vocab * cfg.d_model)                        # head
-    # attention score/value matmuls: 2 * (2*S^2*D_model) fwd, x3 for bwd
-    attn_flops = cfg.n_layers * 12 * S * S * cfg.d_model
-    flops = 6.0 * matmul_params * B * S + attn_flops * B
-    tf = flops / sec / 1e12
-    mfu = tf * 1e12 / V5E_PEAK_FLOPS
-    print(f"# train step d_model={cfg.d_model} L={cfg.n_layers} B={B} "
-          f"S={S}: {sec*1e3:.1f} ms/step, {tf:7.2f} TFLOP/s, "
-          f"MFU={mfu*100:.1f}% (v5e bf16 peak)", flush=True)
-    return mfu
-
-
-def bench_rmsnorm():
     import jax
     import jax.numpy as jnp
     import numpy as np
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     from brpc_tpu.tpu.pallas_ops import rmsnorm
 
@@ -141,24 +130,108 @@ def bench_rmsnorm():
     x = jnp.asarray(rng.normal(size=(N, D)), dtype=jnp.bfloat16)
     w = jnp.asarray(rng.normal(size=(D,)), dtype=jnp.bfloat16)
 
+    def chained(call):
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def loop(x, w, n: int):
+            def body(i, xc):
+                return call(xc, w)
+
+            return jax.lax.fori_loop(0, n, body, x)
+
+        def run(n):
+            jax.device_get(loop(x, w, n)[0, :1])
+
+        sec = _marginal(run, 64, 512)
+        return 2.0 * N * D * 2 / sec / 1e9  # bf16 read + write
+
+    gbps = chained(lambda xc, w: rmsnorm(xc, w, interpret=False,
+                                         block_rows=512))
+
+    rows = 512
+
+    def _copy_kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:]
+
+    def copy_call(xc, w):
+        return pl.pallas_call(
+            _copy_kernel, grid=(N // rows,),
+            in_specs=[pl.BlockSpec((rows, D), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((rows, D), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((N, D), xc.dtype),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel",)))(xc)
+
+    ceil = chained(copy_call)
+    xla = chained(lambda xc, w: xc + jnp.bfloat16(1))
+    print(f"# kernel rmsnorm {N}x{D}: {gbps:7.1f} GB/s HBM "
+          f"({gbps/ceil*100:.0f}% of the {ceil:.0f} GB/s Mosaic-DMA copy "
+          f"ceiling; XLA elementwise wire = {xla:.0f} GB/s — "
+          f"docs/round4-notes.md)", flush=True)
+    return gbps
+
+
+def bench_train_step_mfu():
+    """Single-chip train step of the flagship LM, reported BOTH ways:
+    kernels ON (Pallas flash fwd+bwd, Pallas norm, fused xent — the
+    shipping config) and the plain-XLA baseline (use_flash_attention=False).
+    Config uses n_heads=8 (head_dim 128): the MXU contracts 128 deep, so
+    64-wide heads would leave half the systolic array dark."""
     import functools
 
-    @functools.partial(jax.jit, static_argnames=("n",))
-    def loop(x, w, n: int):
-        def body(i, acc):
-            x2 = x.at[0, 0].add(acc.astype(x.dtype))  # defeat hoisting
-            return acc + rmsnorm(x2, w, interpret=False)[0, 0].astype(
-                jnp.float32) * 1e-6
+    import jax
+    import jax.numpy as jnp
 
-        return jax.lax.fori_loop(0, n, body, jnp.float32(0))
+    from brpc_tpu.tpu import train
 
-    def run(n):
-        float(jax.device_get(loop(x, w, n)))
+    B, S = 8, 1024
 
-    sec = _marginal(run, 32, 256)  # 256 x 512MB of traffic >> sync noise
-    gbps = 2.0 * N * D * 2 / sec / 1e9  # bf16 read + write
-    print(f"# kernel rmsnorm {N}x{D}: {gbps:7.1f} GB/s HBM", flush=True)
-    return gbps
+    def measure(cfg):
+        params = train.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                                    cfg.vocab)
+        targets = jax.random.randint(jax.random.PRNGKey(2), (B, S), 0,
+                                     cfg.vocab)
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def steps(params, tokens, n: int):
+            def body(i, p):
+                loss, grads = jax.value_and_grad(train.loss_fn)(
+                    p, (tokens, targets), cfg)
+                return jax.tree_util.tree_map(
+                    lambda a, g: (a - 1e-4 * g).astype(a.dtype), p, grads)
+
+            return jax.lax.fori_loop(0, n, body, params)
+
+        def run(n):
+            out = steps(params, tokens, n)
+            jax.device_get(jax.tree.leaves(out)[0][:1])  # dependent fetch
+
+        sec = _marginal(run, 1, 5)
+        matmul_params = (cfg.n_layers * (cfg.d_model * 3 * cfg.d_model
+                                         + cfg.d_model * cfg.d_model
+                                         + 2 * cfg.d_model * cfg.d_ff)
+                         + cfg.vocab * cfg.d_model)
+        attn_flops = cfg.n_layers * 12 * S * S * cfg.d_model
+        flops = 6.0 * matmul_params * B * S + attn_flops * B
+        tf = flops / sec / 1e12
+        return sec, tf, tf * 1e12 / V5E_PEAK_FLOPS
+
+    base = dict(vocab=16384, d_model=1024, n_heads=8, n_layers=8,
+                d_ff=4096, max_seq=1024, dtype=jnp.bfloat16)
+    cfg_on = train.ModelConfig(**base, use_flash_attention=True,
+                               use_pallas_norm=True, use_fused_xent=True)
+    cfg_off = train.ModelConfig(**base, use_flash_attention=False,
+                                use_pallas_norm=False,
+                                use_fused_xent=False)
+    sec, tf, mfu = measure(cfg_on)
+    print(f"# train step d_model=1024 L=8 B={B} S={S} KERNELS-ON "
+          f"(flash+norm+xent): {sec*1e3:.1f} ms/step, {tf:7.2f} TFLOP/s, "
+          f"MFU={mfu*100:.1f}% (v5e bf16 peak)", flush=True)
+    sec0, tf0, mfu0 = measure(cfg_off)
+    print(f"# train step d_model=1024 L=8 B={B} S={S} XLA baseline: "
+          f"{sec0*1e3:.1f} ms/step, {tf0:7.2f} TFLOP/s, "
+          f"MFU={mfu0*100:.1f}%", flush=True)
+    return mfu
 
 
 def main():
