@@ -300,6 +300,22 @@ def bench_hybrid_native():
         return
     srv = _BenchServer("127.0.0.1:0", "--native", "--inline")
     try:
+        # service capacity under a C++ load generator — the reference's own
+        # methodology (its bench clients are C++, example/multi_threaded_
+        # echo_c++/client.cpp); the service is FULL-POLICY Python user code
+        from brpc_tpu.rpc.native_transport import bench_echo_native
+
+        host, port = srv.endpoint.split("//")[-1].split("/")[0].split(":")
+        dur = 1500 if QUICK else 4000
+        r1 = bench_echo_native(host, int(port), conns=8, depth=1,
+                               payload=16, duration_ms=dur)
+        r2 = bench_echo_native(host, int(port), conns=8, depth=32,
+                               payload=16, duration_ms=dur)
+        print(f"# hybrid service capacity (C++ load, py full-policy "
+              f"service): sync-8 qps={r1['qps']:,.0f} "
+              f"p50={r1['p50_us']:.0f}us | pipelined 8x32 "
+              f"qps={r2['qps']:,.0f} p50={r2['p50_us']:.0f}us",
+              file=sys.stderr)
         ch = Channel(ChannelOptions(protocol="trpc_std", timeout_ms=30000,
                                     native_transport=True))
         ch.init(srv.endpoint)
@@ -307,7 +323,8 @@ def bench_hybrid_native():
         _run_calls(stub, echo_pb2, b"w" * 16, 4, 25)  # warmup
         calls = 40 if QUICK else 400
         wall, lats = _run_calls(stub, echo_pb2, b"x" * 16, QPS_THREADS, calls)
-        print(f"# hybrid lane (py client+service, native engine): "
+        print(f"# hybrid lane (py client+service, native engine; one core "
+              f"carries BOTH processes + engines): "
               f"qps={len(lats)/wall:,.0f} "
               f"p50={_percentile(lats,0.5)*1e6:.0f}us "
               f"p99={_percentile(lats,0.99)*1e6:.0f}us", file=sys.stderr)

@@ -44,6 +44,7 @@ class Collector:
         # all asks hit this branch (GIL-atomic read; small approximation
         # races only ever deny a touch early)
         self._deny_until = 0.0
+        self._deferred_denies = 0  # counted outside the Adder on the hot path
         self.grants = Adder()
         self.denies = Adder()
         self.grants.expose_as("collector_grants")
@@ -56,15 +57,24 @@ class Collector:
 
     def ask_to_be_sampled(self, weight: int = 1) -> bool:
         """Draw ``weight`` grants from the shared budget. True = sample."""
-        rate = self._rate()
-        if rate <= 0:
-            self.grants.put(weight)
-            return True  # cap disabled
         now = time.monotonic()
         if now < self._deny_until:
-            self.denies.put(weight)
+            # hot deny path (every RPC asks under load): one plain int +=,
+            # no flags read, no reducer; the deferred count flushes into
+            # the denies Adder the next time the gate opens
+            self._deferred_denies += weight
             return False
+        rate = self._rate()
+        if rate <= 0:
+            if self._deferred_denies:  # cap was just disabled: flush
+                d, self._deferred_denies = self._deferred_denies, 0
+                self.denies.put(d)
+            self.grants.put(weight)
+            return True  # cap disabled
         with self._lock:
+            if self._deferred_denies:
+                d, self._deferred_denies = self._deferred_denies, 0
+                self.denies.put(d)
             if self._tokens is None:
                 self._tokens = float(rate)  # full bucket at startup
             elapsed = now - self._last_refill

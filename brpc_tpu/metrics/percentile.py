@@ -60,20 +60,26 @@ class PercentileSamples:
 
 
 class _ThreadReservoir:
-    __slots__ = ("samples", "count", "rng")
+    __slots__ = ("samples", "count", "_seed")
 
     def __init__(self):
         self.samples: List[float] = []
         self.count = 0
-        self.rng = random.Random()
+        self._seed = random.getrandbits(63) | 1
 
     def add(self, value: float) -> None:
         self.count += 1
         if len(self.samples) < SAMPLE_CAPACITY:
             self.samples.append(value)
         else:
-            # classic reservoir replacement keeps a uniform sample
-            j = self.rng.randrange(self.count)
+            # classic reservoir replacement keeps a uniform sample; the
+            # index draw is an LCG, not random.randrange — this runs once
+            # per RPC on the hot path and randrange's rejection loop is
+            # ~2us of pure overhead there (metrics-grade uniformity only)
+            s = (self._seed * 6364136223846793005
+                 + 1442695040888963407) & 0xFFFFFFFFFFFFFFFF
+            self._seed = s
+            j = (s >> 33) % self.count
             if j < SAMPLE_CAPACITY:
                 self.samples[j] = value
 

@@ -132,6 +132,17 @@ class Adder(Reducer):
         if name:
             self.expose_as(name)
 
+    def put(self, value):
+        # specialized hot path: += beats the generic op indirection (this
+        # runs several times per RPC on the server dispatch path)
+        agent = getattr(self._tls, "agent", None)
+        if agent is None:
+            agent = self._agent()
+        agent.value += value
+        return self
+
+    __lshift__ = put
+
     def expose_as(self, name: str):
         from brpc_tpu.metrics.variable import Variable
 

@@ -168,6 +168,10 @@ def load_dataplane() -> Optional[ctypes.CDLL]:
         lib.dp_poll.restype = ctypes.c_int
         lib.dp_poll.argtypes = [ctypes.c_void_p, ev_p, ctypes.c_int,
                                 ctypes.c_int]
+        lib.dp_poll_packed.restype = ctypes.c_int
+        lib.dp_poll_packed.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_uint64, ctypes.c_int,
+                                       ctypes.c_int]
         lib.dp_free.argtypes = [ctypes.c_void_p]
         lib.dp_conn_close.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.dp_conn_stats.restype = ctypes.c_int
@@ -223,7 +227,36 @@ def load_dataplane() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_int32)]
-        if lib.dp_abi_version() != 2:
+        # abi 3: engine-parked sync calls (dp_call_sync) — the caller
+        # blocks in C with the GIL released; the parse thread completes it
+        lib.dp_call_sync.restype = ctypes.c_int
+        lib.dp_call_sync.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
+        lib.dp_respond2.restype = ctypes.c_int
+        lib.dp_respond2.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64]
+        lib.dp_call_sync2.restype = ctypes.c_int
+        lib.dp_call_sync2.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64]
+        lib.dp_sync_complete_py.restype = ctypes.c_int
+        lib.dp_sync_complete_py.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64]
+        if lib.dp_abi_version() != 3:
             _dp_build_error = "dataplane abi mismatch"
             return None
         _dp_lib = lib

@@ -132,6 +132,7 @@ enum {
   DPE_PROTOCOL = 3,    // bad frame
   DPE_OVERCROWDED = 4, // write queue limit
   DPE_NOTFOUND = 5,    // unknown conn id
+  DPE_TIMEDOUT = 6,    // dp_call_sync deadline exceeded
 };
 
 struct DpEvent {
@@ -590,10 +591,35 @@ struct Loop {
   std::vector<std::function<void()>> tasks;
 };
 
+// A Python thread blocked inside dp_call_sync (GIL released): the poller
+// threads complete it directly — no event queue, no Python poller, no
+// threading.Event. This is what makes N sync client threads scale: they
+// park in C, so the interpreter only ever runs ONE of them at a time for
+// the ~µs of pb work around the call. (Reference analog: a bthread
+// blocking on its CallId butex, brpc/controller.cpp Join.)
+struct SyncWaiter {
+  uint64_t cid = 0;
+  uint64_t conn_id = 0;
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  int32_t terr = 0;           // transport error (DPE_*), 0 = completed
+  int32_t code = 0;           // app-level error code from RpcMeta
+  uint64_t attempt = 0;
+  uint64_t att_size = 0;
+  std::string etext;
+  uint8_t* base = nullptr;    // free() handle (may differ from body)
+  uint8_t* body = nullptr;
+  uint64_t body_len = 0;
+};
+
 struct Runtime {
   std::vector<std::unique_ptr<Loop>> loops;
   std::atomic<bool> running{true};
   uint64_t max_body = kDefaultMaxBody;
+
+  std::mutex swmu;  // outstanding dp_call_sync waiters by cid
+  std::unordered_map<uint64_t, SyncWaiter*> sync_waiters;
 
   std::mutex cmu;  // conns + listeners
   std::unordered_map<uint64_t, std::shared_ptr<Conn>> conns;
@@ -936,6 +962,9 @@ void tpu_teardown(Conn* c) {
 
 // Fail a connection: unregister, close, emit event, drop from table.
 // Runs on the owning loop thread (writers route through loop_submit).
+void sync_fail_conn(Runtime* rt, uint64_t conn_id, int err_class,
+                    const char* reason);
+
 void conn_fail(Runtime* rt, const std::shared_ptr<Conn>& c, int err_class,
                const char* reason) {
   bool expected = false;
@@ -956,6 +985,7 @@ void conn_fail(Runtime* rt, const std::shared_ptr<Conn>& c, int err_class,
     rt->tpu_graveyard.push_back(std::move(c->tpu));
   }
   emit_failed(rt, c.get(), err_class, reason);
+  sync_fail_conn(rt, c->id, err_class, reason);
   std::lock_guard<std::mutex> lk(rt->cmu);
   rt->conns.erase(c->id);
 }
@@ -1485,6 +1515,59 @@ void conn_detach(Runtime* rt, const std::shared_ptr<Conn>& c) {
   rt->conns.erase(c->id);
 }
 
+// ---- sync-waiter completion (dp_call_sync)
+SyncWaiter* sync_take(Runtime* rt, uint64_t cid) {
+  std::lock_guard<std::mutex> lk(rt->swmu);
+  auto it = rt->sync_waiters.find(cid);
+  if (it == rt->sync_waiters.end()) return nullptr;
+  SyncWaiter* w = it->second;
+  rt->sync_waiters.erase(it);
+  return w;
+}
+
+// After notify, the completer must not touch w again: the waiter owns the
+// storage (stack frame) and frees it once it re-acquires w->mu and sees
+// done. Holding mu across the notify makes that handoff safe.
+void sync_complete(SyncWaiter* w, int32_t code, uint64_t attempt,
+                   uint64_t att_size, const char* etext, size_t elen,
+                   uint8_t* base, uint8_t* body, uint64_t blen) {
+  std::lock_guard<std::mutex> lk(w->mu);
+  w->code = code;
+  w->attempt = attempt;
+  w->att_size = att_size;
+  if (elen) w->etext.assign(etext, elen);
+  w->base = base;
+  w->body = body;
+  w->body_len = blen;
+  w->done = true;
+  w->cv.notify_one();
+}
+
+// Wake every sync waiter parked on a failing conn (transport error).
+void sync_fail_conn(Runtime* rt, uint64_t conn_id, int err_class,
+                    const char* reason) {
+  std::vector<SyncWaiter*> hit;
+  {
+    std::lock_guard<std::mutex> lk(rt->swmu);
+    for (auto it = rt->sync_waiters.begin();
+         it != rt->sync_waiters.end();) {
+      if (it->second->conn_id == conn_id) {
+        hit.push_back(it->second);
+        it = rt->sync_waiters.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  for (auto* w : hit) {
+    std::lock_guard<std::mutex> lk(w->mu);
+    w->terr = err_class ? err_class : DPE_IO;
+    if (reason) w->etext.assign(reason);
+    w->done = true;
+    w->cv.notify_one();
+  }
+}
+
 // Parsed fast-path event builders (meta struct + names/text + body in ONE
 // allocation — dp_free stays a single free()).
 void batch_fast_request(ParseBatch* b, Conn* c, const MetaLite& m,
@@ -1601,6 +1684,44 @@ void cut_trpc(Runtime* rt, const std::shared_ptr<Conn>& c, RBuf& buf,
       }
     }
     if (!handled) {
+      // a Python thread parked in dp_call_sync for this cid? complete it
+      // right here on the parse thread — no event queue, no GIL. Only
+      // plain responses (no compress/checksum/stream riders) finish
+      // natively; anything else falls through to the EV_FRAME path and
+      // the Python fallback completes the waiter via dp_sync_complete_py.
+      if (is_trpc && meta_ok && !c->is_server && m.has_response &&
+          !m.has_request && !m.compress_type && !m.checksum &&
+          !m.has_stream_settings && m.attachment_size <= body_size) {
+        SyncWaiter* w = sync_take(rt, m.correlation_id);
+        if (w != nullptr) {
+          if (whole && total >= kFastFrameMax) {
+            // steal the read buffer like the EV_FRAME donation path:
+            // megabyte responses reach the sync caller with ZERO copies
+            uint8_t* base = buf.data;
+            uint8_t* bp = buf.data + kHeaderSize + meta_size;
+            buf.data = nullptr;
+            buf.cap = 0;
+            buf.size = 0;
+            pos = 0;
+            flush_batch(rt, c, &batch);
+            sync_complete(w, int32_t(m.resp_error_code),
+                          m.attempt_version, m.attachment_size,
+                          m.resp_error_text.data(),
+                          m.resp_error_text.size(), base, bp, body_size);
+            return;
+          }
+          uint8_t* blk = nullptr;
+          if (body_size) {
+            blk = static_cast<uint8_t*>(malloc(body_size));
+            memcpy(blk, body, body_size);
+          }
+          sync_complete(w, int32_t(m.resp_error_code), m.attempt_version,
+                        m.attachment_size, m.resp_error_text.data(),
+                        m.resp_error_text.size(), blk, blk, body_size);
+          pos += kHeaderSize + total;
+          continue;
+        }
+      }
       if (whole && total >= kFastFrameMax) {
         // the buffer holds exactly this one large frame: hand the WHOLE
         // buffer to the consumer instead of memcpy'ing megabytes — the
@@ -2351,7 +2472,7 @@ void loop_run(Runtime* rt, int li) {
 // ===================================================================== ABI
 extern "C" {
 
-int dp_abi_version() { return 2; }
+int dp_abi_version() { return 3; }
 
 void* dp_rt_create(int nloops, uint64_t max_body) {
   if (nloops <= 0) nloops = 2;
@@ -2376,6 +2497,22 @@ void* dp_rt_create(int nloops, uint64_t max_body) {
 void dp_rt_shutdown(void* h) {
   auto* rt = static_cast<Runtime*>(h);
   rt->running.store(false);
+  {
+    // wake every parked sync caller before the loops die
+    std::vector<SyncWaiter*> all;
+    {
+      std::lock_guard<std::mutex> lk(rt->swmu);
+      for (auto& kv : rt->sync_waiters) all.push_back(kv.second);
+      rt->sync_waiters.clear();
+    }
+    for (auto* w : all) {
+      std::lock_guard<std::mutex> lk(w->mu);
+      w->terr = DPE_IO;
+      w->etext = "runtime shutdown";
+      w->done = true;
+      w->cv.notify_one();
+    }
+  }
   for (auto& l : rt->loops) {
     uint64_t one = 1;
     ssize_t r = write(l->evfd, &one, 8);
@@ -2822,6 +2959,155 @@ int dp_call(void* h, uint64_t conn_id, const char* svc, uint64_t svc_len,
   return conn_writev(rt, c, bufs, lens, nseg);
 }
 
+// Struct-parameter respond (layout mirrored by _RESPOND_IN in
+// rpc/native_transport.py): 13 marshalled scalars -> pointers + sizes.
+struct RespondParams {
+  uint64_t conn_id;    //  0
+  uint64_t cid;        //  8
+  uint64_t attempt;    // 16
+  int32_t error_code;  // 24
+  int32_t compress;    // 28
+  int32_t queue;       // 32
+  int32_t _pad;        // 36
+};
+
+int dp_respond2(void* h, const uint8_t* pb, const char* etext,
+                uint64_t etext_len, const uint8_t* payload, uint64_t plen,
+                const uint8_t* att, uint64_t alen) {
+  auto* p = reinterpret_cast<const RespondParams*>(pb);
+  return dp_respond(h, p->conn_id, p->cid, p->attempt, p->error_code,
+                    etext, etext_len, payload, plen, att, alen,
+                    p->compress, p->queue);
+}
+
+// Blocking fast call: the calling (Python) thread parks HERE, in C, with
+// the GIL released — the engine's parse thread completes it directly.
+// Returns DPE_OK when an RPC-level answer arrived (out_code = app error
+// code, body ownership passes to the caller: free via dp_free(out_base)),
+// DPE_TIMEDOUT on deadline, other DPE_* on transport failure.
+int dp_call_sync(void* h, uint64_t conn_id, const char* svc,
+                 uint64_t svc_len, const char* meth, uint64_t meth_len,
+                 uint64_t cid, int64_t log_id, int64_t trace_id,
+                 int64_t span_id, int32_t timeout_ms,
+                 const uint8_t* payload, uint64_t plen, const uint8_t* att,
+                 uint64_t alen, int32_t* out_code, uint64_t* out_attempt,
+                 uint64_t* out_att_size, void** out_base, void** out_body,
+                 uint64_t* out_body_len, char* etext_buf,
+                 uint64_t* etext_cap_len) {
+  auto* rt = static_cast<Runtime*>(h);
+  SyncWaiter w;
+  w.cid = cid;
+  w.conn_id = conn_id;
+  {
+    std::lock_guard<std::mutex> lk(rt->swmu);
+    rt->sync_waiters.emplace(cid, &w);
+  }
+  int rc = dp_call(h, conn_id, svc, svc_len, meth, meth_len, cid, 0,
+                   log_id, trace_id, span_id, timeout_ms, payload, plen,
+                   att, alen, 0);
+  if (rc != DPE_OK) {
+    if (sync_take(rt, cid) != nullptr) {  // nobody owns us: bail
+      if (etext_cap_len) *etext_cap_len = 0;
+      return rc;
+    }
+    // a completer (conn_fail fan-out) already took the waiter — it is
+    // committed to signaling; take its verdict below
+  }
+  {
+    std::unique_lock<std::mutex> lk(w.mu);
+    if (timeout_ms > 0) {
+      if (!w.cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                         [&] { return w.done; })) {
+        lk.unlock();
+        if (sync_take(rt, cid) != nullptr) {
+          if (etext_cap_len) *etext_cap_len = 0;
+          return DPE_TIMEDOUT;
+        }
+        lk.lock();  // completion in flight: it is quick, wait it out
+        w.cv.wait(lk, [&] { return w.done; });
+      }
+    } else {
+      w.cv.wait(lk, [&] { return w.done; });
+    }
+  }
+  uint64_t cap = etext_cap_len ? *etext_cap_len : 0;
+  uint64_t n = cap < w.etext.size() ? cap : w.etext.size();
+  if (n) memcpy(etext_buf, w.etext.data(), n);
+  if (etext_cap_len) *etext_cap_len = n;
+  if (w.terr) return w.terr;
+  *out_code = w.code;
+  *out_attempt = w.attempt;
+  *out_att_size = w.att_size;
+  *out_base = w.base;
+  *out_body = w.body;
+  *out_body_len = w.body_len;
+  return DPE_OK;
+}
+
+// Struct-parameter variant of dp_call_sync: ctypes marshals TWO pointer
+// args instead of 23 scalars (~4us/call of marshalling on the shared
+// core). Layout mirrored by _SYNC_PARAMS in rpc/native_transport.py.
+struct SyncCallParams {
+  uint64_t conn_id;    //  0  in
+  uint64_t cid;        //  8  in
+  int64_t log_id;      // 16  in
+  int64_t trace_id;    // 24  in
+  int64_t span_id;     // 32  in
+  int32_t timeout_ms;  // 40  in
+  int32_t code;        // 44  out: app error code
+  uint64_t attempt;    // 48  out
+  uint64_t att_size;   // 56  out
+  uint64_t base;       // 64  out: free handle (dp_free)
+  uint64_t body;       // 72  out
+  uint64_t body_len;   // 80  out
+  uint64_t etext_len;  // 88  out
+  char etext[256];     // 96  out
+};
+
+int dp_call_sync2(void* h, uint8_t* pb, const char* svc, uint64_t svc_len,
+                  const char* meth, uint64_t meth_len,
+                  const uint8_t* payload, uint64_t plen,
+                  const uint8_t* att, uint64_t alen) {
+  auto* p = reinterpret_cast<SyncCallParams*>(pb);
+  int32_t code = 0;
+  uint64_t attempt = 0, att_size = 0, blen = 0;
+  void* base = nullptr;
+  void* body = nullptr;
+  uint64_t elen = sizeof(p->etext);
+  int rc = dp_call_sync(h, p->conn_id, svc, svc_len, meth, meth_len,
+                        p->cid, p->log_id, p->trace_id, p->span_id,
+                        p->timeout_ms, payload, plen, att, alen, &code,
+                        &attempt, &att_size, &base, &body, &blen,
+                        p->etext, &elen);
+  p->code = code;
+  p->attempt = attempt;
+  p->att_size = att_size;
+  p->base = reinterpret_cast<uint64_t>(base);
+  p->body = reinterpret_cast<uint64_t>(body);
+  p->body_len = blen;
+  p->etext_len = elen;
+  return rc;
+}
+
+// Python-side fallback completion: a response that needed Python policy
+// (decompression, big donated frame via EV_FRAME, ZC tunnel reassembly)
+// finishes a parked sync caller through here.
+int dp_sync_complete_py(void* h, uint64_t cid, int32_t code,
+                        const char* etext, uint64_t elen,
+                        const uint8_t* body, uint64_t blen,
+                        uint64_t att_size, uint64_t attempt) {
+  auto* rt = static_cast<Runtime*>(h);
+  SyncWaiter* w = sync_take(rt, cid);
+  if (w == nullptr) return DPE_NOTFOUND;
+  uint8_t* blk = nullptr;
+  if (blen) {
+    blk = static_cast<uint8_t*>(malloc(blen));
+    memcpy(blk, body, blen);
+  }
+  sync_complete(w, code, attempt, att_size, etext, elen, blk, blk, blen);
+  return DPE_OK;
+}
+
 // Return the pool blocks named by an EV_RESPONSE_ZC ack blob to the peer
 // (the consumer has finished reading the zero-copy segments).
 int dp_tpu_ack(void* h, uint64_t conn_id, const uint8_t* ack, uint64_t len) {
@@ -2872,6 +3158,73 @@ int dp_poll(void* h, DpEvent* out, int maxn, int timeout_ms) {
     n++;
   }
   return n;
+}
+
+// Batched event delivery with inline payloads: one ctypes call + ONE
+// buffer read hands Python a whole poll batch (VERDICT r3 #1 — the
+// interpreter boundary is crossed per BATCH, not per event). Small events
+// are memcpy'd back-to-back into the caller's buffer and freed here (no
+// per-event dp_free crossing); big events (donated read buffers, ZC
+// tunnel descriptors) stay zero-copy as pointer records the consumer
+// frees as before. Record layout (host endian, packed):
+//   i32 kind (bit 30 set = pointer record)  i32 tag
+//   u64 conn_id  i64 aux  u64 meta_len  u64 body_len
+//   inline:  meta bytes, body bytes
+//   pointer: u64 base, u64 meta_ptr, u64 body_ptr
+constexpr int32_t kPackedPtrFlag = 1 << 30;
+constexpr uint64_t kPackInlineMax = 8 << 10;  // per-event inline budget
+constexpr uint64_t kPackedHdr = 40;
+
+int dp_poll_packed(void* h, uint8_t* buf, uint64_t cap, int timeout_ms,
+                   int maxn) {
+  auto* rt = static_cast<Runtime*>(h);
+  std::unique_lock<std::mutex> lk(rt->emu);
+  if (rt->events.empty()) {
+    rt->ecv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [rt] {
+      return !rt->events.empty() || !rt->running.load();
+    });
+  }
+  uint64_t off = 0;
+  int n = 0;
+  while (n < maxn && !rt->events.empty()) {
+    DpEvent& ev = rt->events.front();
+    // EV_RESPONSE_ZC carries body=nullptr with an INFORMATIONAL body_len
+    // (the payload lives in pool blocks named by the meta); copy/ship
+    // only bytes that exist
+    uint64_t blen = ev.body ? ev.body_len : 0;
+    uint64_t blob = ev.meta_len + blen;
+    bool inlined = blob <= kPackInlineMax;
+    uint64_t need = kPackedHdr + (inlined ? blob : 24);
+    if (off + need > cap) break;  // delivered next call
+    uint8_t* p = buf + off;
+    int32_t kind = ev.kind | (inlined ? 0 : kPackedPtrFlag);
+    memcpy(p, &kind, 4);
+    memcpy(p + 4, &ev.tag, 4);
+    memcpy(p + 8, &ev.conn_id, 8);
+    memcpy(p + 16, &ev.aux, 8);
+    memcpy(p + 24, &ev.meta_len, 8);
+    memcpy(p + 32, &blen, 8);
+    p += kPackedHdr;
+    if (inlined) {
+      if (ev.meta_len) memcpy(p, ev.meta, ev.meta_len);
+      if (blen) memcpy(p + ev.meta_len, ev.body, blen);
+      free(ev.base);
+    } else {
+      uint64_t base = reinterpret_cast<uint64_t>(ev.base);
+      uint64_t mp = reinterpret_cast<uint64_t>(ev.meta);
+      uint64_t bp = reinterpret_cast<uint64_t>(ev.body);
+      memcpy(p, &base, 8);
+      memcpy(p + 8, &mp, 8);
+      memcpy(p + 16, &bp, 8);
+    }
+    off += need;
+    // accounting must mirror push_event's += (which uses the raw
+    // body_len even when body is null)
+    rt->event_bytes -= ev.meta_len + ev.body_len + sizeof(DpEvent);
+    rt->events.pop_front();
+    n++;
+  }
+  return int(off);  // bytes written; 0 = timeout/empty
 }
 
 void dp_free(void* base) { free(base); }

@@ -16,6 +16,7 @@ from typing import Optional
 
 from brpc_tpu.butil.endpoint import EndPoint
 from brpc_tpu.fiber import call_id as _cid
+from brpc_tpu.trace import span as _span
 from brpc_tpu.metrics.latency_recorder import LatencyRecorder
 from brpc_tpu.policy import compress as _compress
 from brpc_tpu.rpc import errors
@@ -301,8 +302,6 @@ class Channel:
                 cntl.compress_type != _compress.COMPRESS_NONE
                 or cntl.stream_id or (cntl.backup_request_ms or 0) > 0):
             return (False, cntl)
-        from brpc_tpu.trace import span as _span
-
         # sampled or propagated traces ride the fast path too: the packed
         # meta carries trace_id/span_id natively (ReqLite fields)
         span = _span.start_client_span(md.service_name, md.method_name,
@@ -348,7 +347,21 @@ class Channel:
 
     def _fast_sync(self, md, svc_b, meth_b, payload, att, log_id,
                    timeout_ms, max_retry, response, cntl, span):
-        from brpc_tpu.rpc.native_transport import NativeSocket, _fast_cid
+        # Sync callers park INSIDE the engine (dp_call_sync): the GIL is
+        # released for the whole round trip and the engine's parse thread
+        # completes the call directly — no poller dispatch, no
+        # threading.Event, no per-completion GIL battle between N sync
+        # client threads (the pre-r4 shape collapsed at 8 threads).
+        global _nt
+        if _nt is None:  # lazy: import cycle at module load
+            from brpc_tpu.rpc import native_transport
+
+            _nt = native_transport
+        DPE_EOF, DPE_IO = _nt.DPE_EOF, _nt.DPE_IO
+        DPE_NOTFOUND, DPE_TIMEDOUT = _nt.DPE_NOTFOUND, _nt.DPE_TIMEDOUT
+        EngineSyncRec = _nt.EngineSyncRec
+        NativeSocket = _nt.NativeSocket
+        _fast_cid = _nt._fast_cid
 
         start_ns = _time.perf_counter_ns()
         deadline = (_time.monotonic() + timeout_ms / 1000.0) \
@@ -359,8 +372,9 @@ class Channel:
         single = self.options.connection_type == "single"
         # single-remote cache; lb and pooled/short paths re-select
         sock = self._fast_sock if single else None
-        rec = None
-        reusable = True  # rec may return to the TLS pool (not abandoned)
+        body = b""
+        att_size = 0
+        resp_size = 0
         while True:
             try:
                 if sock is None or sock.failed:
@@ -385,56 +399,55 @@ class Channel:
                     if cntl is not None:
                         cntl.span = span
                     return (False, cntl)
+                if deadline:
+                    left_ms = int((deadline - _time.monotonic()) * 1000)
+                    if left_ms <= 0:
+                        code, text = errors.ERPCTIMEDOUT, \
+                            "deadline exceeded"
+                        break
+                else:
+                    left_ms = 0
                 cid = next(_fast_cid)
-                rec = _get_rec()
+                # sentinel: completions that need Python anyway (EV_FRAME
+                # donations, decompression, ZC tunnels, set_failed fan-out)
+                # forward to the parked waiter via dp_sync_complete_py
+                rec = EngineSyncRec(sock._dp, cid)
                 sock._fast_calls[cid] = rec
                 if sock.failed:
                     # raced set_failed's fan-out: our entry may be missed
                     sock._fast_calls.pop(cid, None)
                     code, text = errors.EFAILEDSOCKET, "socket failed"
                 else:
-                    # NEVER queue a sync send: this thread blocks right
-                    # after, and if it IS a flusher thread (handler making
-                    # a sync downstream call) nobody would flush it
-                    rc = sock._dp.call(sock.conn_id, svc_b, meth_b, cid, 0,
-                                       log_id, timeout_ms, payload, att,
-                                       False,
-                                       span.trace_id if span else 0,
-                                       span.span_id if span else 0)
+                    sock.out_messages += 1
+                    sock.out_bytes += len(payload) + len(att)
+                    rc, acode, atext, abody, asize = sock._dp.call_sync(
+                        sock.conn_id, svc_b, meth_b, cid, log_id, left_ms,
+                        payload, att,
+                        span.trace_id if span else 0,
+                        span.span_id if span else 0)
+                    sock._fast_calls.pop(cid, None)
+                    if rc == DPE_TIMEDOUT:
+                        code, text = errors.ERPCTIMEDOUT, \
+                            "deadline exceeded"
+                        break
                     if rc != 0:
-                        sock._fast_calls.pop(cid, None)
-                        if rc in (1, 2, 5):  # EOF/IO/NOTFOUND: conn is gone
+                        if rc in (DPE_EOF, DPE_IO, DPE_NOTFOUND):
                             sock.set_failed(errors.EFAILEDSOCKET,
                                             f"native send failed ({rc})")
                         code = _map_dpe(rc)
-                        text = f"native send failed ({rc})"
+                        text = atext or f"native call failed ({rc})"
                     else:
-                        sock.out_messages += 1
-                        sock.out_bytes += len(payload) + len(att)
-                        if deadline:
-                            left = deadline - _time.monotonic()
-                            timed_out = left <= 0 or not rec.event.wait(left)
-                        else:
-                            rec.event.wait()
-                            timed_out = False
-                        if timed_out:
-                            if sock._fast_calls.pop(cid, None) is not None:
-                                # abandoned mid-flight: the poller may still
-                                # complete this rec — it can't be pooled
-                                reusable = False
-                                code = errors.ERPCTIMEDOUT
-                                text = "deadline exceeded"
-                                break
-                            rec.event.wait()  # completion already in flight
-                        code, text = rec.code, rec.text
+                        sock.in_messages += 1
+                        sock.in_bytes += len(abody)
+                        code, text = acode, atext
+                        body, att_size = abody, asize
+                        resp_size = len(abody)
             if code == errors.OK:
                 break
             if code in errors.DEFAULT_RETRYABLE and retries < max_retry \
                     and (not deadline or _time.monotonic() < deadline):
                 retries += 1
                 code, text = errors.OK, ""
-                if rec is not None:
-                    rec.event.clear()
                 if sock is not None and not single:
                     self._release_socket(sock, False)  # ambiguous checkout
                     sock = None
@@ -444,10 +457,9 @@ class Channel:
             break
         latency_us = (_time.perf_counter_ns() - start_ns) // 1000
         resp_att = b""
-        if code == errors.OK and rec is not None:
-            body = rec.body
-            if rec.att_size:
-                cut = len(body) - rec.att_size
+        if code == errors.OK:
+            if att_size:
+                cut = len(body) - att_size
                 resp_att = body[cut:]
                 body = body[:cut]
             try:
@@ -455,14 +467,12 @@ class Channel:
                     response.ParseFromString(body)
             except Exception as e:
                 code, text = errors.ERESPONSE, f"parse response: {e}"
-        if rec is not None and reusable:
-            _put_rec(rec)
         if not single:
             self._release_socket(sock, code == errors.OK)
         self.latency_recorder.record(latency_us)
         if span is not None:
             span.request_size = len(payload) + len(att)
-            span.response_size = len(rec.body) if rec is not None else 0
+            span.response_size = resp_size
             span.end(code)
         if self._lb is not None and sock is not None \
                 and getattr(sock, "remote", None) is not None:
@@ -481,38 +491,13 @@ class Channel:
         return (True, response)
 
 
+_nt = None  # lazy brpc_tpu.rpc.native_transport (import cycle at load)
+
+
 def _map_dpe(rc: int) -> int:
-    from brpc_tpu.rpc import native_transport as _nt
+    from brpc_tpu.rpc import native_transport
 
-    return _nt._DPE_TO_ERR.get(rc, errors.EFAILEDSOCKET)
-
-
-_rec_tls = threading.local()
-
-
-def _get_rec():
-    """Per-thread FastCallRec reuse: a sync caller runs one call at a time,
-    so a cleanly-completed rec (event consumed, not abandoned to a late
-    completion) cycles instead of allocating rec+Event per RPC."""
-    rec = getattr(_rec_tls, "rec", None)
-    if rec is not None:
-        _rec_tls.rec = None
-        rec.event.clear()
-        rec.code = 0
-        rec.text = ""
-        rec.body = b""
-        rec.att_size = 0
-        rec.on_complete = None
-        return rec
-    from brpc_tpu.rpc.native_transport import FastCallRec
-
-    rec = FastCallRec()
-    rec.event = threading.Event()
-    return rec
-
-
-def _put_rec(rec) -> None:
-    _rec_tls.rec = rec
+    return native_transport._DPE_TO_ERR.get(rc, errors.EFAILEDSOCKET)
 
 
 class _FastErr:

@@ -112,17 +112,25 @@ class MethodEntry:
                 with self._conc_lock:
                     self.current_concurrency += 1
             return ok
+        if not self.max_concurrency:
+            # unlimited: gauge-only counter, skip the lock (shared-core
+            # hot path; a preemption race only drifts the gauge)
+            self.current_concurrency += 1
+            return True
         with self._conc_lock:
-            if self.max_concurrency and self.current_concurrency >= self.max_concurrency:
+            if self.current_concurrency >= self.max_concurrency:
                 return False
             self.current_concurrency += 1
             return True
 
     def on_response(self, latency_us: float, error_code: int) -> None:
-        with self._conc_lock:
+        if self.limiter is None and not self.max_concurrency:
             self.current_concurrency -= 1
-        if self.limiter is not None:
-            self.limiter.on_response(latency_us, error_code)
+        else:
+            with self._conc_lock:
+                self.current_concurrency -= 1
+            if self.limiter is not None:
+                self.limiter.on_response(latency_us, error_code)
         self.latency.record(latency_us)
         if error_code != errors.OK:
             self.errors_count.put(1)
@@ -536,13 +544,21 @@ class Server:
 
     # ------------------------------------------------------------- admission
     def add_concurrency(self) -> bool:
+        if not self.options.max_concurrency:
+            # no limit configured: the counter is observability-only, and
+            # a lock round-trip per RPC is measurable on the shared core.
+            # A lost update under preemption only drifts the gauge.
+            self.concurrency += 1
+            return True
         with self._concurrency_lock:
-            if (self.options.max_concurrency
-                    and self.concurrency >= self.options.max_concurrency):
+            if self.concurrency >= self.options.max_concurrency:
                 return False
             self.concurrency += 1
             return True
 
     def sub_concurrency(self) -> None:
+        if not self.options.max_concurrency:
+            self.concurrency -= 1
+            return
         with self._concurrency_lock:
             self.concurrency -= 1
