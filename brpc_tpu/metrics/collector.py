@@ -88,8 +88,11 @@ class Collector:
             else:
                 granted = False
                 # bucket refills at `rate`/s: deny lock-free until the
-                # missing fraction of a token has accrued
-                self._deny_until = now + (weight - self._tokens) / rate
+                # missing fraction of a token has accrued — capped at 1s
+                # so a runtime rate change (including disabling the cap)
+                # takes effect within a second
+                self._deny_until = now + min(
+                    (weight - self._tokens) / rate, 1.0)
         (self.grants if granted else self.denies).put(weight)
         return granted
 

@@ -1525,6 +1525,20 @@ SyncWaiter* sync_take(Runtime* rt, uint64_t cid) {
   return w;
 }
 
+// Conn-scoped take: a response only completes a waiter parked on ITS
+// connection (cids are process-unique, but a buggy/malicious peer could
+// echo a guessed cid — without this check it would complete another
+// channel's call with foreign bytes).
+SyncWaiter* sync_take_conn(Runtime* rt, uint64_t cid, uint64_t conn_id) {
+  std::lock_guard<std::mutex> lk(rt->swmu);
+  auto it = rt->sync_waiters.find(cid);
+  if (it == rt->sync_waiters.end()) return nullptr;
+  if (it->second->conn_id != conn_id) return nullptr;
+  SyncWaiter* w = it->second;
+  rt->sync_waiters.erase(it);
+  return w;
+}
+
 // After notify, the completer must not touch w again: the waiter owns the
 // storage (stack frame) and frees it once it re-acquires w->mu and sees
 // done. Holding mu across the notify makes that handoff safe.
@@ -1692,7 +1706,7 @@ void cut_trpc(Runtime* rt, const std::shared_ptr<Conn>& c, RBuf& buf,
       if (is_trpc && meta_ok && !c->is_server && m.has_response &&
           !m.has_request && !m.compress_type && !m.checksum &&
           !m.has_stream_settings && m.attachment_size <= body_size) {
-        SyncWaiter* w = sync_take(rt, m.correlation_id);
+        SyncWaiter* w = sync_take_conn(rt, m.correlation_id, c->id);
         if (w != nullptr) {
           if (whole && total >= kFastFrameMax) {
             // steal the read buffer like the EV_FRAME donation path:
@@ -1722,6 +1736,20 @@ void cut_trpc(Runtime* rt, const std::shared_ptr<Conn>& c, RBuf& buf,
           continue;
         }
       }
+      // BIG fast-eligible server requests skip the EV_FRAME donation and
+      // ride the parsed fast path too (VERDICT r3 #6): one native memcpy
+      // here (GIL-free) replaces the Python-side pb meta parse + IOBuf
+      // split/pack copies of the full pipeline, and the response returns
+      // through dp_respond's zero-copy writev. Pooled bulk conns then
+      // only serialize on the two unavoidable Python copies.
+      if (fast && is_trpc && meta_ok && c->is_server && m.has_request &&
+          !m.has_response && !m.compress_type && !m.checksum &&
+          !m.has_stream_settings && !m.has_auth &&
+          m.attachment_size <= body_size) {
+        batch_fast_request(&batch, c.get(), m, body, body_size);
+        pos += kHeaderSize + total;
+        continue;
+      }
       if (whole && total >= kFastFrameMax) {
         // the buffer holds exactly this one large frame: hand the WHOLE
         // buffer to the consumer instead of memcpy'ing megabytes — the
@@ -1747,20 +1775,15 @@ void cut_trpc(Runtime* rt, const std::shared_ptr<Conn>& c, RBuf& buf,
       // parsed fast-path events: Python receives pre-cracked meta fields
       // and never runs protobuf on the hot path. Anything with policy
       // riding the meta (compress, checksum, auth, streams) takes the
-      // full EV_FRAME path; trace ids ride ReqLite natively.
+      // full EV_FRAME path; trace ids ride ReqLite natively. (Server
+      // requests of EVERY size were already taken above.)
       if (fast && is_trpc && meta_ok && !m.compress_type && !m.checksum &&
           !m.has_stream_settings && !m.has_auth &&
-          m.attachment_size <= body_size) {
-        if (c->is_server && m.has_request && !m.has_response) {
-          batch_fast_request(&batch, c.get(), m, body, body_size);
-          pos += kHeaderSize + total;
-          continue;
-        }
-        if (!c->is_server && m.has_response && !m.has_request) {
-          batch_fast_response(&batch, c.get(), m, body, body_size);
-          pos += kHeaderSize + total;
-          continue;
-        }
+          m.attachment_size <= body_size &&
+          !c->is_server && m.has_response && !m.has_request) {
+        batch_fast_response(&batch, c.get(), m, body, body_size);
+        pos += kHeaderSize + total;
+        continue;
       }
       uint8_t* blk = static_cast<uint8_t*>(
           malloc(uint64_t(meta_size) + body_size + 1));
@@ -3178,24 +3201,39 @@ constexpr uint64_t kPackedHdr = 40;
 int dp_poll_packed(void* h, uint8_t* buf, uint64_t cap, int timeout_ms,
                    int maxn) {
   auto* rt = static_cast<Runtime*>(h);
-  std::unique_lock<std::mutex> lk(rt->emu);
-  if (rt->events.empty()) {
-    rt->ecv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [rt] {
-      return !rt->events.empty() || !rt->running.load();
-    });
+  // Phase 1 (under the event lock): POP the fitting events into a local
+  // batch — fit arithmetic only, no memcpy/free, so the engine's parse
+  // threads never stall on rt->emu behind a megabyte of packing.
+  std::vector<DpEvent> batch;
+  {
+    std::unique_lock<std::mutex> lk(rt->emu);
+    if (rt->events.empty()) {
+      rt->ecv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [rt] {
+        return !rt->events.empty() || !rt->running.load();
+      });
+    }
+    uint64_t off = 0;
+    while (int(batch.size()) < maxn && !rt->events.empty()) {
+      DpEvent& ev = rt->events.front();
+      uint64_t blen = ev.body ? ev.body_len : 0;
+      uint64_t blob = ev.meta_len + blen;
+      uint64_t need = kPackedHdr + (blob <= kPackInlineMax ? blob : 24);
+      if (off + need > cap) break;  // delivered next call
+      off += need;
+      rt->event_bytes -= ev.meta_len + ev.body_len + sizeof(DpEvent);
+      batch.push_back(ev);
+      rt->events.pop_front();
+    }
   }
+  // Phase 2 (lock-free): pack into the caller's buffer.
   uint64_t off = 0;
-  int n = 0;
-  while (n < maxn && !rt->events.empty()) {
-    DpEvent& ev = rt->events.front();
+  for (DpEvent& ev : batch) {
     // EV_RESPONSE_ZC carries body=nullptr with an INFORMATIONAL body_len
     // (the payload lives in pool blocks named by the meta); copy/ship
     // only bytes that exist
     uint64_t blen = ev.body ? ev.body_len : 0;
     uint64_t blob = ev.meta_len + blen;
     bool inlined = blob <= kPackInlineMax;
-    uint64_t need = kPackedHdr + (inlined ? blob : 24);
-    if (off + need > cap) break;  // delivered next call
     uint8_t* p = buf + off;
     int32_t kind = ev.kind | (inlined ? 0 : kPackedPtrFlag);
     memcpy(p, &kind, 4);
@@ -3209,6 +3247,7 @@ int dp_poll_packed(void* h, uint8_t* buf, uint64_t cap, int timeout_ms,
       if (ev.meta_len) memcpy(p, ev.meta, ev.meta_len);
       if (blen) memcpy(p + ev.meta_len, ev.body, blen);
       free(ev.base);
+      off += kPackedHdr + blob;
     } else {
       uint64_t base = reinterpret_cast<uint64_t>(ev.base);
       uint64_t mp = reinterpret_cast<uint64_t>(ev.meta);
@@ -3216,13 +3255,8 @@ int dp_poll_packed(void* h, uint8_t* buf, uint64_t cap, int timeout_ms,
       memcpy(p, &base, 8);
       memcpy(p + 8, &mp, 8);
       memcpy(p + 16, &bp, 8);
+      off += kPackedHdr + 24;
     }
-    off += need;
-    // accounting must mirror push_event's += (which uses the raw
-    // body_len even when body is null)
-    rt->event_bytes -= ev.meta_len + ev.body_len + sizeof(DpEvent);
-    rt->events.pop_front();
-    n++;
   }
   return int(off);  // bytes written; 0 = timeout/empty
 }

@@ -17,6 +17,7 @@ Carried-over invariants (SURVEY §2.4 Socket row):
 from __future__ import annotations
 
 import errno as _errno
+import itertools
 import socket as _socket
 import ssl as _ssl
 import threading
@@ -151,6 +152,9 @@ class Socket:
             views = [memoryview(bytes(data))]
         else:
             views = [data]
+        # a queued 0-byte view would livelock the drainer (send returns 0,
+        # nothing pops); filter here so the queue only ever holds payload
+        views = [v for v in views if v.nbytes]
         nbytes = sum(v.nbytes for v in views)
         self.last_active = _time.monotonic()
         if id_wait is not None:
@@ -172,8 +176,13 @@ class Socket:
         return 0
 
     def _drain_write_queue(self) -> None:
-        """Send until the queue empties or the kernel pushes back."""
+        """Send until the queue empties or the kernel pushes back. Plain
+        sockets drain VECTORED (sendmsg: every queued view in one
+        syscall — an RPC packet is header+meta+payload views, and one
+        send per view was 3-5 syscalls per packet); TLS sockets (no
+        sendmsg on SSLSocket) fall back to per-view send."""
         while True:
+            heads = None
             with self._write_lock:
                 if not self._write_queue:
                     self._write_registered = False
@@ -185,9 +194,18 @@ class Socket:
                         self.dispatcher.disable_write(self.fd)
                     close_now = self._close_after_drain
                     break
-                head = self._write_queue[0]
+                # SSLSocket EXPOSES sendmsg but raises NotImplementedError
+                sendmsg = None if isinstance(self._sock, _ssl.SSLSocket) \
+                    else getattr(self._sock, "sendmsg", None)
+                if sendmsg is not None:
+                    heads = list(itertools.islice(self._write_queue, 0, 16))
+                else:
+                    head = self._write_queue[0]
             try:
-                n = self._sock.send(head)
+                if heads is not None:
+                    n = sendmsg(heads)
+                else:
+                    n = self._sock.send(head)
             except (BlockingIOError, _ssl.SSLWantWriteError,
                     _ssl.SSLWantReadError):
                 # TLS renegotiation can want a READ to make write progress;
@@ -204,10 +222,14 @@ class Socket:
             g_out_bytes.put(n)
             with self._write_lock:
                 self._write_queued_bytes -= n
-                if n == head.nbytes:
-                    self._write_queue.popleft()
-                else:
-                    self._write_queue[0] = head[n:]
+                while n:
+                    h = self._write_queue[0]
+                    if n >= h.nbytes:
+                        n -= h.nbytes
+                        self._write_queue.popleft()
+                    else:
+                        self._write_queue[0] = h[n:]
+                        n = 0
         if close_now:
             self.close()
 

@@ -178,3 +178,45 @@ def partition(fn: Callable, mesh: Mesh, axis: str):
         return _f(x)
 
     return wrapped
+
+
+# ------------------------------------------------ ParallelChannel lowering
+# The registry + entry point the RPC layer's CollectiveScheme drives
+# (rpc/combo_channels.py): fn must be known BY NAME on both execution paths
+# (the shard_map program here, the device-method RPC fallback there).
+_collective_fns = {}
+
+
+def register_collective_fn(name: str, fn: Callable) -> None:
+    _collective_fns[name] = fn
+
+
+def collective_fn(name: str) -> Callable:
+    fn = _collective_fns.get(name)
+    if fn is None:
+        raise KeyError(f"no collective fn registered as {name!r}")
+    return fn
+
+
+def fanout_call(fn: Callable, mesh: Mesh, axis: str, merge: str, x):
+    """ParallelChannel fan-out as ONE program: x shards over `axis` (dim
+    0), fn runs per shard, the MERGER is the collective. Result semantics
+    match the RPC fallback exactly:
+
+      gather -> concat of per-shard responses in sub-channel order
+                (the default MergeFrom/repeated-field concatenation)
+      sum    -> ONE summed response (an aggregating ResponseMerger)
+      none   -> concat, same as gather (results stay per-partition)
+    """
+    if merge == "sum":
+        @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P())
+        def _sum(shard):
+            return lax.psum(fn(shard), axis)
+
+        return _sum(x)
+
+    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+    def _gather(shard):
+        return fn(shard)
+
+    return _gather(x)
