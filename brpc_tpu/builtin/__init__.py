@@ -44,16 +44,27 @@ def dispatch(server, http) -> Optional[Tuple[int, str, bytes, Optional[dict]]]:
 
     Returns None when the path is not a builtin (the caller then tries pb
     services), else (status, content_type, body, extra_headers).
+
+    A server may carry ``builtin_overrides`` ({page -> handler}) that win
+    over the process-global registry FOR THAT SERVER ONLY — this is how
+    tools/rpc_view's proxy forwards pages without hijacking the builtin
+    pages of every other server in the process.
     """
     ensure_builtin_registered()
     seg = http.path.strip("/").split("/", 1)[0]
     if seg == "" :
         seg = "index"
-    with _lock:
-        svc = _services.get(seg)
-    if svc is None:
-        return None
-    out = svc.handler(server, http)
+    handler = None
+    overrides = getattr(server, "builtin_overrides", None)
+    if overrides is not None:
+        handler = overrides.get(seg)
+    if handler is None:
+        with _lock:
+            svc = _services.get(seg)
+        if svc is None:
+            return None
+        handler = svc.handler
+    out = handler(server, http)
     if len(out) == 3:
         status, ctype, body = out
         return status, ctype, body, None

@@ -243,6 +243,14 @@ class Server:
             from brpc_tpu.builtin.grpc_health import GrpcHealthService
 
             self._services["Health"] = GrpcHealthService(self)
+        # dashboard pages over the binary protocol — what rpc_view's
+        # proxy mode speaks (reference tools/rpc_view). Guard on the
+        # INSTANCE's name: service_name can be shadowed (tests do).
+        from brpc_tpu.builtin.view_service import BuiltinViewService
+
+        _view = BuiltinViewService()
+        if _view.service_name not in self._services:
+            self.add_service(_view)
         if self.options.ssl is not None and self._ssl_ctx is None:
             # fail FAST on a bad cert path — not per-connection at runtime
             from brpc_tpu.rpc.ssl_helper import build_server_context

@@ -347,6 +347,7 @@ class TestTools:
             _flags.set_flag("rpc_dump_ratio", "0.0")
 
     def test_rpc_view(self, capsys):
+        # one-shot fetch goes over the BINARY protocol now
         from tools import rpc_view
 
         server, _ = start_server()
@@ -354,5 +355,39 @@ class TestTools:
             rc = rpc_view.main([str(server.listen_endpoint()), "status"])
             assert rc == 0
             assert "EchoService" in capsys.readouterr().out
+            # --http fallback still works
+            rc = rpc_view.main([str(server.listen_endpoint()), "status",
+                                "--http"])
+            assert rc == 0
+            assert "EchoService" in capsys.readouterr().out
         finally:
+            server.stop(); server.join(timeout=2)
+
+    def test_rpc_view_proxy(self):
+        # the reference tools/rpc_view shape: a standalone HTTP proxy that
+        # speaks the binary protocol to the target — builtin pages of the
+        # TARGET render through the PROXY's HTTP port
+        from brpc_tpu.policy.http_protocol import http_fetch
+        from tools import rpc_view
+
+        server, _ = start_server()
+        proxy = None
+        try:
+            proxy = rpc_view.serve("127.0.0.1:0",
+                                   str(server.listen_endpoint()),
+                                   block=False)
+            pep = str(proxy.listen_endpoint())
+            resp = http_fetch(pep, "GET", "/status", timeout=5)
+            assert resp.status == 200
+            body = resp.body.decode()
+            assert "EchoService" in body
+            # the proxied page reports the TARGET's endpoint, not the proxy
+            assert str(server.listen_endpoint()) in body
+            resp = http_fetch(pep, "GET", "/vars", timeout=5)
+            assert resp.status == 200 and resp.body
+            resp = http_fetch(pep, "GET", "/index", timeout=5)
+            assert resp.status == 200 and b"/status" in resp.body
+        finally:
+            if proxy is not None:
+                proxy.stop(); proxy.join(timeout=2)
             server.stop(); server.join(timeout=2)

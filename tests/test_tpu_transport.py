@@ -142,7 +142,16 @@ class TestTunnelLoopback:
 
     def test_server_stop_fails_pending_cleanly(self):
         server = Server(ServerOptions())
-        svc = Service()
+
+        # a SUBCLASS scopes the name override — patching the property on
+        # the shared Service base renamed every later service in the
+        # process (caught when BuiltinViewService started auto-mounting)
+        class _SlowSvc(Service):
+            @property
+            def service_name(self):
+                return "EchoService"
+
+        svc = _SlowSvc()
 
         gate = threading.Event()
 
@@ -152,7 +161,6 @@ class TestTunnelLoopback:
 
         svc.add_method("Echo", slow, echo_pb2.EchoRequest,
                        echo_pb2.EchoResponse)
-        svc.__class__.service_name = property(lambda self: "EchoService")
         server.add_service(svc)
         server.start("tpu://127.0.0.1:0/0")
         stub = _stub_for(server, timeout_ms=2000)
