@@ -240,6 +240,12 @@ def load_dataplane() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_void_p),
             ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
+        lib.dp_call2.restype = ctypes.c_int
+        lib.dp_call2.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64]
         lib.dp_respond2.restype = ctypes.c_int
         lib.dp_respond2.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,

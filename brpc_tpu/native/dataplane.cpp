@@ -2982,6 +2982,29 @@ int dp_call(void* h, uint64_t conn_id, const char* svc, uint64_t svc_len,
   return conn_writev(rt, c, bufs, lens, nseg);
 }
 
+// Struct-parameter call (layout mirrored by _CALL_IN in
+// rpc/native_transport.py): the async client lane's dp_call with 17
+// marshalled scalars folded into one reusable param block.
+struct CallParams {
+  uint64_t conn_id;    //  0
+  uint64_t cid;        //  8
+  int64_t log_id;      // 16
+  int64_t trace_id;    // 24
+  int64_t span_id;     // 32
+  int32_t timeout_ms;  // 40
+  int32_t queue;       // 44
+};
+
+int dp_call2(void* h, const uint8_t* pb, const char* svc,
+             uint64_t svc_len, const char* meth, uint64_t meth_len,
+             const uint8_t* payload, uint64_t plen, const uint8_t* att,
+             uint64_t alen) {
+  auto* p = reinterpret_cast<const CallParams*>(pb);
+  return dp_call(h, p->conn_id, svc, svc_len, meth, meth_len, p->cid, 0,
+                 p->log_id, p->trace_id, p->span_id, p->timeout_ms,
+                 payload, plen, att, alen, p->queue);
+}
+
 // Struct-parameter respond (layout mirrored by _RESPOND_IN in
 // rpc/native_transport.py): 13 marshalled scalars -> pointers + sizes.
 struct RespondParams {

@@ -333,9 +333,9 @@ class Channel:
             issued = call.issue()
             if issued is None:
                 if cntl is not None:
-                    # the ctor planted its join event on the caller's
-                    # controller — the full pipeline must join by call id
-                    cntl._fast_join_event = None
+                    # the ctor planted itself on the caller's controller —
+                    # the full pipeline must join by call id instead
+                    cntl._fast_call_ref = None
                 if cntl is None and span is not None:
                     cntl = Controller()
                 if cntl is not None:
@@ -525,7 +525,7 @@ class FastClientController:
                  "response_attachment", "request_attachment", "log_id",
                  "compress_type", "_current_socket", "_retry_count",
                  "timeout_ms", "max_retry", "backup_request_ms", "stream_id",
-                 "span", "_fast_join_event")
+                 "span", "_fast_call_ref")
 
     def __init__(self):
         self._error_code = errors.OK
@@ -543,7 +543,7 @@ class FastClientController:
         self.backup_request_ms = None
         self.stream_id = 0
         self.span = None
-        self._fast_join_event = None
+        self._fast_call_ref = None
 
     def failed(self) -> bool:
         return self._error_code != errors.OK
@@ -560,10 +560,13 @@ class FastClientController:
         self._error_text = text or errors.error_text(code)
 
     def join(self, timeout=None) -> bool:
-        ev = self._fast_join_event
-        if ev is None:
+        call = self._fast_call_ref
+        if call is None:
             return True
-        return ev.wait(timeout)
+        return call.join_wait(timeout)
+
+
+_join_install_lock = threading.Lock()  # join_wait's one-Event guarantee
 
 
 class _AsyncFastCall:
@@ -598,17 +601,25 @@ class _AsyncFastCall:
         self.sock = None
         self.span = span
         self.settled = False
-        # join() support: the controller the caller holds must block until
-        # completion, like the slow path's call-id join
-        self.join_ev = threading.Event()
-        cntl._fast_join_event = self.join_ev
+        # join() support: the controller the caller holds can block until
+        # completion like the slow path's call-id join — but the Event is
+        # LAZY (join_wait): done-style callers never join, and an Event
+        # alloc+set per RPC is measurable at pipelined rates
+        self.join_ev = None
+        cntl._fast_call_ref = self
 
     def issue(self):
         """True = in flight; None = not a native socket (caller falls back
         to the full pipeline; only possible before the first send)."""
-        from brpc_tpu.rpc.native_transport import (FastCallRec, NativeSocket,
-                                                   _fast_cid,
-                                                   on_flusher_thread)
+        global _nt
+        if _nt is None:
+            from brpc_tpu.rpc import native_transport
+
+            _nt = native_transport
+        FastCallRec = _nt.FastCallRec
+        NativeSocket = _nt.NativeSocket
+        _fast_cid = _nt._fast_cid
+        on_flusher_thread = _nt.on_flusher_thread
 
         ch = self.channel
         single = ch.options.connection_type == "single"
@@ -645,11 +656,11 @@ class _AsyncFastCall:
             return self._retry_or_finalize(errors.EFAILEDSOCKET,
                                            "socket failed")
         span = self.span
-        rc = sock._dp.call(sock.conn_id, self.svc_b, self.meth_b, cid, 0,
-                           self.log_id, self.timeout_ms, self.payload,
-                           self.att, on_flusher_thread(),
-                           span.trace_id if span else 0,
-                           span.span_id if span else 0)
+        rc = sock._dp.call2(sock.conn_id, self.svc_b, self.meth_b, cid,
+                            self.log_id, self.timeout_ms, self.payload,
+                            self.att, on_flusher_thread(),
+                            span.trace_id if span else 0,
+                            span.span_id if span else 0)
         if rc != 0:
             if sock._fast_calls.pop(cid, None) is None:
                 return True  # concurrent failure fan-out owns completion
@@ -688,6 +699,20 @@ class _AsyncFastCall:
         r = self.issue()
         if r is None:
             self._finalize(errors.EHOSTDOWN, "server set changed lanes")
+
+    def join_wait(self, timeout=None) -> bool:
+        if self.settled:
+            return True
+        ev = self.join_ev
+        if ev is None:
+            with _join_install_lock:  # two joiners must share ONE event
+                ev = self.join_ev
+                if ev is None:
+                    ev = threading.Event()
+                    self.join_ev = ev
+            if self.settled:  # finalize raced the install: don't hang
+                ev.set()
+        return ev.wait(timeout)
 
     def _complete(self, rec) -> None:
         if rec.code != errors.OK:
@@ -733,13 +758,20 @@ class _AsyncFastCall:
             ch._lb.feedback(self.sock.remote, code, cntl.latency_us)
         if ch.options.connection_type != "single":
             ch._release_socket(self.sock, code == errors.OK)
-        self.join_ev.set()  # joiners wake before done runs (slow-path order)
+        ev = self.join_ev
+        if ev is not None:  # joiners wake before done runs (slow-path order)
+            ev.set()
         try:
             self.done(cntl)
         except Exception:
             import logging
 
             logging.getLogger("brpc_tpu").exception("fast done raised")
+        # cntl._fast_call_ref pins this object to the controller's
+        # lifetime (a reference cycle, GC-only) — drop the heavy request
+        # bytes so held controllers don't retain every payload/attachment
+        self.payload = b""
+        self.att = b""
 
 
 class RawMessage:

@@ -156,6 +156,7 @@ _SYNC_IN = struct.Struct("<QQqqqi")   # conn,cid,log,trace,span,timeout
 _SYNC_OUT = struct.Struct("<iQQQQQQ")  # code,attempt,att,base,body,blen,elen
 _SYNC_SIZE = 352
 _RESPOND_IN = struct.Struct("<QQQiii")  # conn,cid,attempt,code,ctype,queue
+_CALL_IN = struct.Struct("<QQqqqii")    # conn,cid,log,trace,span,to,queue
 
 
 class NativeSocket:
@@ -328,6 +329,22 @@ class NativeDataplane:
             cid, attempt, log_id, trace_id, span_id, timeout_ms,
             payload, len(payload), attachment, len(attachment),
             1 if queue else 0)
+
+    def call2(self, conn_id: int, service: bytes, method: bytes, cid: int,
+              log_id: int, timeout_ms: int, payload: bytes,
+              attachment: bytes, queue: bool, trace_id: int = 0,
+              span_id: int = 0) -> int:
+        """Async fast call; scalars cross in one reusable param block
+        (CallParams in dataplane.cpp) instead of 17 marshalled args."""
+        tls = _sync_tls
+        cbuf = getattr(tls, "cbuf", None)
+        if cbuf is None:
+            cbuf = tls.cbuf = ctypes.create_string_buffer(48)
+        _CALL_IN.pack_into(cbuf, 0, conn_id, cid, log_id, trace_id,
+                           span_id, timeout_ms, 1 if queue else 0)
+        return self._lib.dp_call2(
+            self._rt, cbuf, service, len(service), method, len(method),
+            payload, len(payload), attachment, len(attachment))
 
     def call_sync(self, conn_id: int, service: bytes, method: bytes,
                   cid: int, log_id: int, timeout_ms: int, payload: bytes,

@@ -92,6 +92,14 @@ class TestSingleFileExamples:
         out = run_single("examples/selective_echo/client.py", ["-n", "6"])
         assert "killed srv0" in out
 
+    def test_collective_fanout(self):
+        out = run_single("examples/collective_fanout/client.py", [])
+        assert "mesh detected: True" in out and "OK" in out
+
+    def test_dashboard_proxy(self):
+        out = run_single("examples/dashboard_proxy/client.py", [])
+        assert "over trpc_std OK" in out
+
     def test_partition_echo(self):
         out = run_single("examples/partition_echo/client.py", ["-n", "2"])
         assert "p0" in out and "p2" in out
