@@ -656,6 +656,9 @@ class _AsyncFastCall:
             return self._retry_or_finalize(errors.EFAILEDSOCKET,
                                            "socket failed")
         span = self.span
+        # capture sizes BEFORE the send: the GIL is released inside the
+        # ctypes call, so completion may run before this thread resumes
+        nbytes = len(self.payload) + len(self.att)
         rc = sock._dp.call2(sock.conn_id, self.svc_b, self.meth_b, cid,
                             self.log_id, self.timeout_ms, self.payload,
                             self.att, on_flusher_thread(),
@@ -670,7 +673,7 @@ class _AsyncFastCall:
             return self._retry_or_finalize(_map_dpe(rc),
                                            f"native send failed ({rc})")
         sock.out_messages += 1
-        sock.out_bytes += len(self.payload) + len(self.att)
+        sock.out_bytes += nbytes
         return True
 
     def _retry_or_finalize(self, code: int, text: str):
@@ -767,11 +770,11 @@ class _AsyncFastCall:
             import logging
 
             logging.getLogger("brpc_tpu").exception("fast done raised")
-        # cntl._fast_call_ref pins this object to the controller's
-        # lifetime (a reference cycle, GC-only) — drop the heavy request
-        # bytes so held controllers don't retain every payload/attachment
-        self.payload = b""
-        self.att = b""
+        # break the cntl <-> call reference cycle so the call (and its
+        # payload/attachment bytes) is refcount-freed the moment the last
+        # holder drops it; a post-completion join() falls through to the
+        # settled/call-id path and returns immediately
+        cntl._fast_call_ref = None
 
 
 class RawMessage:

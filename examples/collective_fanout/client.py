@@ -18,7 +18,9 @@ import argparse
 import os
 import sys
 
-# a virtual 8-device CPU mesh unless the caller brought real devices
+# the demo pins itself to a virtual 8-device CPU mesh so it runs the
+# same everywhere (on multi-TPU hosts, drop these two lines and the
+# same code lowers onto the real chips)
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 try:
     import jax
