@@ -112,6 +112,67 @@ def test_fast_async_done(native_server):
     assert seen["resp"].message == "async"
 
 
+def test_fast_async_big_response_pointer_record(native_server):
+    # an ASYNC caller with a >=64KB response: the donated EV_FRAME rides
+    # dp_poll_packed as a POINTER record (not inlined) and must complete
+    # the rec through _process_frame; also pins join-after-done semantics
+    ch = _fast_channel(native_server.listen_endpoint())
+    stub = Stub(ch, SVC)
+    ev = threading.Event()
+    seen = {}
+
+    def done(cntl):
+        seen["att"] = cntl.response_attachment
+        seen["cntl"] = cntl
+        ev.set()
+
+    cntl = Controller()
+    cntl.request_attachment = b"\xa5" * (512 << 10)
+    stub.Echo(echo_pb2.EchoRequest(message="big"), controller=cntl,
+              done=done)
+    assert ev.wait(10)
+    assert seen["att"] == b"\xa5" * (512 << 10)
+    assert seen["cntl"].join(1)  # post-completion join returns immediately
+
+
+def test_fast_concurrent_joiners_share_one_event():
+    # two threads joining one in-flight async call must BOTH wake (the
+    # lazy join-event install is guarded; a lost event would hang one)
+    held = []
+    entered = threading.Event()
+
+    class Holder(Service):
+        DESCRIPTOR = SVC
+
+        def Echo(self, cntl, request, done):
+            held.append(done)  # answer later from another thread
+            entered.set()
+
+    srv = Server(ServerOptions(native_dataplane=True))
+    srv.add_service(Holder())
+    srv.start("127.0.0.1:0")
+    try:
+        ch = _fast_channel(srv.listen_endpoint())
+        stub = Stub(ch, SVC)
+        cntl = Controller()
+        stub.Echo(echo_pb2.EchoRequest(message="j"), controller=cntl,
+                  done=lambda _c: None)
+        assert entered.wait(5)
+        results = []
+        ts = [threading.Thread(target=lambda: results.append(
+            cntl.join(10))) for _ in range(2)]
+        for t in ts:
+            t.start()
+        time.sleep(0.2)  # both joiners parked on the lazy event
+        held[0](echo_pb2.EchoResponse(message="late"))
+        for t in ts:
+            t.join(10)
+        assert results == [True, True]
+    finally:
+        srv.stop()
+        srv.join(timeout=5)
+
+
 def test_fast_timeout_held_done(native_server):
     held = []
 
