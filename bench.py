@@ -50,6 +50,13 @@ QPS_THREADS = 8
 QPS_SECONDS = 1.0 if QUICK else 4.0
 
 
+def _host_port(endpoint: str):
+    """'proto://host:port/ordinal' or 'host:port' -> (host, port_int)."""
+    hp = endpoint.split("//")[-1].split("/")[0]
+    host, port = hp.rsplit(":", 1)
+    return host, int(port)
+
+
 def _percentile(sorted_lat, p):
     if not sorted_lat:
         return 0.0
@@ -317,17 +324,38 @@ def bench_hybrid_native():
         # echo_c++/client.cpp); the service is FULL-POLICY Python user code
         from brpc_tpu.rpc.native_transport import bench_echo_native
 
-        host, port = srv.endpoint.split("//")[-1].split("/")[0].split(":")
+        host, port = _host_port(srv.endpoint)
         dur = 1500 if QUICK else 4000
-        r1 = bench_echo_native(host, int(port), conns=8, depth=1,
+        r1 = bench_echo_native(host, port, conns=8, depth=1,
                                payload=16, duration_ms=dur)
-        r2 = bench_echo_native(host, int(port), conns=8, depth=32,
+        r2 = bench_echo_native(host, port, conns=8, depth=32,
                                payload=16, duration_ms=dur)
         print(f"# hybrid service capacity (C++ load, py full-policy "
               f"service): sync-8 qps={r1['qps']:,.0f} "
               f"p50={r1['p50_us']:.0f}us | pipelined 8x32 "
               f"qps={r2['qps']:,.0f} p50={r2['p50_us']:.0f}us",
               file=sys.stderr)
+        # NULL-SERVICE CONTROL (VERDICT r4 #2a): same C++ load generator,
+        # same poll loop, but the Python body is a raw body echo with the
+        # policy machinery OFF — the process-pair interpreter-crossing
+        # ceiling on this 1-core box. full-policy/control is the
+        # framework's own share.
+        srv0 = _BenchServer("127.0.0.1:0", "--native", "--null")
+        try:
+            h0, p0 = _host_port(srv0.endpoint)
+            c1 = bench_echo_native(h0, p0, conns=8, depth=1,
+                                   payload=16, duration_ms=dur)
+            c2 = bench_echo_native(h0, p0, conns=8, depth=32,
+                                   payload=16, duration_ms=dur)
+            print(f"# NULL-SERVICE CONTROL (py body = raw echo, policy "
+                  f"off): sync-8 qps={c1['qps']:,.0f} "
+                  f"p50={c1['p50_us']:.0f}us | pipelined 8x32 "
+                  f"qps={c2['qps']:,.0f} | full-policy/control = "
+                  f"{r1['qps']/max(c1['qps'],1):.0%} sync, "
+                  f"{r2['qps']/max(c2['qps'],1):.0%} pipelined",
+                  file=sys.stderr)
+        finally:
+            srv0.close()
         ch = Channel(ChannelOptions(protocol="trpc_std", timeout_ms=30000,
                                     native_transport=True))
         ch.init(srv.endpoint)

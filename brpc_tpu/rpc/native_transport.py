@@ -698,7 +698,13 @@ class NativeDataplane:
                         item = self._crack_fast_request(conn_id, meta_b,
                                                         body_b)
                         if item is not None:
-                            if item[0].options.usercode_inline:
+                            nulls = item[0]._null_methods
+                            if nulls and (item[2], item[3]) in nulls:
+                                # null-service control: raw body echo,
+                                # zero policy (register_null_method)
+                                self.respond(conn_id, item[4], item[5],
+                                             0, b"", item[11], b"", True)
+                            elif item[0].options.usercode_inline:
                                 # reference default: user code runs in the
                                 # parsing thread; responses batch-flush
                                 fpr(item)

@@ -195,6 +195,9 @@ class Server:
         self._native_lid = None         # native dataplane listener id
         self._native_dp = None
         self._native_echoes = []        # (service, method) C++ fast paths
+        self._null_methods = set()      # (service, method) null-service
+        # control lane: the poll loop answers these with a raw body echo
+        # and NO policy (bench_r05: isolates the Python-crossing ceiling)
         self._method_cache = {}         # (service, method) -> MethodEntry
         self._ssl_ctx = None            # built lazily from options.ssl
         self._master_service = None     # catch-all generic service
@@ -342,6 +345,16 @@ class Server:
         if self._native_dp is not None and self._native_lid is not None:
             self._native_dp.register_echo(self._native_lid, service_name,
                                           method_name, max_concurrency)
+
+    def register_null_method(self, service_name: str,
+                             method_name: str) -> None:
+        """Benchmark CONTROL lane (VERDICT r4 #2a): the native poll loop
+        answers this method from Python with a raw body echo and nothing
+        else — no pb decode/encode, no admission, no method status, no
+        span. The gap between this and the full-policy path is the
+        framework's own cost; the control itself is the process-pair
+        interpreter-crossing ceiling. Not a serving feature."""
+        self._null_methods.add((service_name, method_name))
 
     def native_method_stats(self):
         """[(service, method, stats-dict)] for native services (the /status

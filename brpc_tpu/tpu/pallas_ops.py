@@ -132,6 +132,22 @@ def _dot_f32(a, b, *, trans_a: bool = False, trans_b: bool = False):
         preferred_element_type=jnp.float32)
 
 
+def _causal_three_way(live, full, accumulate):
+    """Three-way causal tile split (VERDICT r4 #1): tiles fully below the
+    diagonal run the mask-free body, the diagonal band runs the masked
+    body, tiles above the diagonal run nothing. `live`/`full` are traced
+    scalars; `accumulate(masked)` instantiates the tile body."""
+    import jax.experimental.pallas as pl
+
+    @pl.when(full)
+    def _():
+        accumulate(False)
+
+    @pl.when(jnp.logical_and(live, jnp.logical_not(full)))
+    def _():
+        accumulate(True)
+
+
 def attention_reference(q, k, v, causal: bool = False):
     """O(S^2)-memory reference for numerics tests."""
     s = jnp.einsum("qd,kd->qk", q.astype(jnp.float32),
@@ -156,13 +172,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    def _accumulate():
+    def _accumulate(masked: bool):
         q = q_ref[:]
         k = k_ref[:]
         v = v_ref[:]
         scale = 1.0 / float(q.shape[-1]) ** 0.5
         s = _dot_f32(q, k, trans_b=True) * scale
-        if causal:
+        if masked:
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32,
                                                        (bq, bk), 0)
             k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32,
@@ -177,12 +193,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         m_scr[:] = m_new
 
     if causal:
-        # tiles fully above the diagonal contribute nothing — skip them
-        @pl.when(qi * bq + bq - 1 >= ki * bk)
-        def _():
-            _accumulate()
+        _causal_three_way(qi * bq + bq - 1 >= ki * bk,
+                          qi * bq >= ki * bk + bk - 1,
+                          _accumulate)
     else:
-        _accumulate()
+        _accumulate(False)
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -254,7 +269,8 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
 # ---------------------------------------------------------------------------
 def _flash_fwd_bhsd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                            m_scr, l_scr, acc_scr, *,
-                           causal: bool, bq: int, bk: int, nk: int):
+                           causal: bool, bq: int, bk: int, nk: int,
+                           bn: int = 1):
     import jax.experimental.pallas as pl
 
     qi = pl.program_id(1)
@@ -266,41 +282,47 @@ def _flash_fwd_bhsd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    def _accumulate():
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        scale = 1.0 / float(q.shape[-1]) ** 0.5
-        s = _dot_f32(q, k, trans_b=True) * scale
-        if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32,
-                                                       (bq, bk), 0)
-            k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32,
-                                                       (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_prev = m_scr[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + _dot_f32(p.astype(v.dtype), v)
-        m_scr[:] = m_new
+    def _accumulate(masked: bool):
+        # bn heads ride one grid step (static unroll): the per-step
+        # pipeline overhead (~µs on this substrate, docs/round5-notes.md)
+        # is amortized over bn tiles' worth of MXU work
+        for j in range(bn):
+            q = q_ref[j]
+            k = k_ref[j]
+            v = v_ref[j]
+            scale = 1.0 / float(q.shape[-1]) ** 0.5
+            s = _dot_f32(q, k, trans_b=True) * scale
+            if masked:
+                q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32,
+                                                           (bq, bk), 0)
+                k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32,
+                                                           (bq, bk), 1)
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            m_prev = m_scr[j]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_scr[j] = l_scr[j] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_scr[j] = acc_scr[j] * alpha + _dot_f32(p.astype(v.dtype), v)
+            m_scr[j] = m_new
 
     if causal:
-        @pl.when(qi * bq + bq - 1 >= ki * bk)
-        def _():
-            _accumulate()
+        _causal_three_way(qi * bq + bq - 1 >= ki * bk,
+                          qi * bq >= ki * bk + bk - 1,
+                          _accumulate)
     else:
-        _accumulate()
+        _accumulate(False)
 
     @pl.when(ki == nk - 1)
     def _finish():
-        l = l_scr[:]
-        safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_scr[:] / safe).astype(o_ref.dtype)
-        # fully-masked rows keep lse = NEG_INF (l == 0): the backward
-        # kernels key their "row attended to nothing" guard off it
-        lse_ref[0] = jnp.where(l == 0.0, NEG_INF, m_scr[:] + jnp.log(safe))
+        for j in range(bn):
+            l = l_scr[j]
+            safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[j] = (acc_scr[j] / safe).astype(o_ref.dtype)
+            # fully-masked rows keep lse = NEG_INF (l == 0): the backward
+            # kernels key their "row attended to nothing" guard off it
+            lse_ref[j] = jnp.where(l == 0.0, NEG_INF,
+                                   m_scr[j] + jnp.log(safe))
 
 
 def _flash_dq_kernel(pos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -315,14 +337,14 @@ def _flash_dq_kernel(pos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    def _accumulate():
+    def _accumulate(masked: bool):
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
         scale = 1.0 / float(q.shape[-1]) ** 0.5
         s = _dot_f32(q, k, trans_b=True) * scale
-        if causal:
+        if masked:
             q_pos = pos_ref[0, 0] + qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
             k_pos = pos_ref[0, 1] + ki * bk + jax.lax.broadcasted_iota(
@@ -337,11 +359,13 @@ def _flash_dq_kernel(pos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_scr[:] = dq_scr[:] + _dot_f32(ds.astype(k.dtype), k) * scale
 
     if causal:
-        @pl.when(pos_ref[0, 0] + qi * bq + bq - 1 >= pos_ref[0, 1] + ki * bk)
-        def _():
-            _accumulate()
+        # absolute positions: ring hops feed runtime offsets
+        _causal_three_way(
+            pos_ref[0, 0] + qi * bq + bq - 1 >= pos_ref[0, 1] + ki * bk,
+            pos_ref[0, 0] + qi * bq >= pos_ref[0, 1] + ki * bk + bk - 1,
+            _accumulate)
     else:
-        _accumulate()
+        _accumulate(False)
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -361,14 +385,14 @@ def _flash_dkv_kernel(pos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    def _accumulate():
+    def _accumulate(masked: bool):
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
         scale = 1.0 / float(q.shape[-1]) ** 0.5
         s = _dot_f32(q, k, trans_b=True) * scale           # [bq, bk]
-        if causal:
+        if masked:
             q_pos = pos_ref[0, 0] + qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
             k_pos = pos_ref[0, 1] + ki * bk + jax.lax.broadcasted_iota(
@@ -386,11 +410,13 @@ def _flash_dkv_kernel(pos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                                          trans_a=True) * scale
 
     if causal:
-        @pl.when(pos_ref[0, 0] + qi * bq + bq - 1 >= pos_ref[0, 1] + ki * bk)
-        def _():
-            _accumulate()
+        # absolute positions: ring hops feed runtime offsets
+        _causal_three_way(
+            pos_ref[0, 0] + qi * bq + bq - 1 >= pos_ref[0, 1] + ki * bk,
+            pos_ref[0, 0] + qi * bq >= pos_ref[0, 1] + ki * bk + bk - 1,
+            _accumulate)
     else:
-        _accumulate()
+        _accumulate(False)
 
     @pl.when(qi == nq - 1)
     def _finish():
@@ -428,40 +454,315 @@ def _pick_blocks(sq, sk, block_q, block_k, interpret, causal=False):
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "bq", "bk",
-                                             "interpret"))
+                                             "interpret", "bn"))
 def _flash_fwd_bhsd(q, k, v, causal: bool, bq: int, bk: int,
-                    interpret: bool):
-    """Forward over [N, S, D] (N = B*H): returns (o [N,S,D], lse [N,S])."""
+                    interpret: bool, bn: int = 1):
+    """Forward over [N, S, D] (N = B*H): returns (o [N,S,D], lse [N,S]).
+    ``bn`` = heads per grid step (must divide N); >1 amortizes per-step
+    pipeline overhead at the cost of bn x the VMEM working set."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     n, sq, d = q.shape
     sk = k.shape[1]
     nq, nk = sq // bq, sk // bk
+    if n % bn:
+        raise ValueError(f"bn ({bn}) must divide batch*heads ({n})")
     kernel = functools.partial(_flash_fwd_bhsd_kernel, causal=causal,
-                               bq=bq, bk=bk, nk=nk)
+                               bq=bq, bk=bk, nk=nk, bn=bn)
     params = (None if interpret else pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary")))
     return pl.pallas_call(
         kernel,
-        grid=(n, nq, nk),
+        grid=(n // bn, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((bn, bq, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((bn, bk, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((bn, bk, d), lambda b, qi, ki: (b, ki, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((bn, bq, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((bn, bq, 1), lambda b, qi, ki: (b, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n, sq, d), q.dtype),
             jax.ShapeDtypeStruct((n, sq, 1), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bn, bq, 1), jnp.float32),
+            pltpu.VMEM((bn, bq, 1), jnp.float32),
+            pltpu.VMEM((bn, bq, d), jnp.float32),
+        ],
+        compiler_params=params,
+        interpret=interpret,
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Folded (triangular) causal flash forward — round 5, VERDICT r4 #1.
+#
+# The (qi, ki) grid pays this substrate's ~1.2 µs/step pipeline overhead
+# AND a K/V tile fetch even for skipped above-diagonal tiles. For causal
+# with bq == bk the live tiles form the lower triangle, so this variant's
+# grid IS the triangle: step t of nq*(nq+1)/2 maps to (qi, ki) with
+# qi = row(t) (inverse triangular number, computed in the index maps),
+# ki = t - qi*(qi+1)/2. No skipped steps, no wasted fetches; diagonal
+# steps (ki == qi) run the masked body, interior steps run mask-free.
+# bn heads share each step to amortize the fixed per-step cost.
+# ---------------------------------------------------------------------------
+def _tri_row(t):
+    """Row of linear triangular index t (qi such that qi*(qi+1)/2 <= t <
+    (qi+1)*(qi+2)/2), with integer fix-up of the f32 sqrt."""
+    qi = ((jnp.sqrt(8.0 * t.astype(jnp.float32) + 1.0) - 1.0) / 2.0
+          ).astype(jnp.int32)
+    qi = jnp.where(qi * (qi + 1) // 2 > t, qi - 1, qi)
+    qi = jnp.where((qi + 1) * (qi + 2) // 2 <= t, qi + 1, qi)
+    return qi
+
+
+def _flash_fwd_folded_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                             m_scr, l_scr, acc_scr, *,
+                             b: int, bn: int, diag_split: bool):
+    import jax.experimental.pallas as pl
+
+    t = pl.program_id(1)
+    qi = _tri_row(t)
+    ki = t - qi * (qi + 1) // 2
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _update(j, rows, s, v):
+        """Online-softmax update of scratch rows `rows` with scores s."""
+        m_prev = m_scr[j, rows]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[j, rows] = (l_scr[j, rows] * alpha
+                          + jnp.sum(p, axis=-1, keepdims=True))
+        acc_scr[j, rows] = (acc_scr[j, rows] * alpha
+                            + _dot_f32(p.astype(v.dtype), v))
+        m_scr[j, rows] = m_new
+
+    def _accumulate(masked: bool):
+        for j in range(bn):
+            q = q_ref[j]
+            k = k_ref[j]
+            v = v_ref[j]
+            scale = 1.0 / float(q.shape[-1]) ** 0.5
+            if not masked:
+                _update(j, slice(None),
+                        _dot_f32(q, k, trans_b=True) * scale, v)
+            elif not diag_split:
+                # on-diagonal tile: triangular mask with RELATIVE
+                # positions (qi*b + r >= ki*b + c, qi == ki -> r >= c)
+                s = _dot_f32(q, k, trans_b=True) * scale
+                r_pos = jax.lax.broadcasted_iota(jnp.int32, (b, b), 0)
+                c_pos = jax.lax.broadcasted_iota(jnp.int32, (b, b), 1)
+                s = jnp.where(r_pos >= c_pos, s, NEG_INF)
+                _update(j, slice(None), s, v)
+            else:
+                # 2x2 diagonal decomposition: the upper-right quadrant is
+                # fully masked and never computed (25% of the diagonal
+                # tile's MXU work); the two on-diagonal half-tiles get
+                # the half-size triangular mask
+                h = b // 2
+                r = jax.lax.broadcasted_iota(jnp.int32, (h, h), 0)
+                c = jax.lax.broadcasted_iota(jnp.int32, (h, h), 1)
+                tri = r >= c
+                q0, q1 = q[0:h], q[h:b]
+                s00 = _dot_f32(q0, k[0:h], trans_b=True) * scale
+                _update(j, slice(0, h),
+                        jnp.where(tri, s00, NEG_INF), v[0:h])
+                s10 = _dot_f32(q1, k[0:h], trans_b=True) * scale
+                s11 = _dot_f32(q1, k[h:b], trans_b=True) * scale
+                s1 = jnp.concatenate(
+                    [s10, jnp.where(tri, s11, NEG_INF)], axis=1)
+                _update(j, slice(h, b), s1, v)
+
+    @pl.when(ki != qi)
+    def _():
+        _accumulate(False)
+
+    @pl.when(ki == qi)
+    def _():
+        _accumulate(True)
+
+    @pl.when(ki == qi)  # last visit of this q-tile: normalize + write
+    def _finish():
+        for j in range(bn):
+            l = l_scr[j]
+            safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[j] = (acc_scr[j] / safe).astype(o_ref.dtype)
+            lse_ref[j] = jnp.where(l == 0.0, NEG_INF,
+                                   m_scr[j] + jnp.log(safe))
+
+
+@functools.partial(jax.jit, static_argnames=("b", "interpret", "bn",
+                                             "diag_split"))
+def _flash_fwd_folded(q, k, v, b: int, interpret: bool, bn: int = 1,
+                      diag_split: bool = False):
+    """Causal forward over [N, S, D] via the triangular grid; bq = bk = b.
+    Returns (o, lse). Causal masking uses absolute positions aligned at 0
+    (the non-ring case); ring hops keep the (qi, ki) kernels."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, sq, d = q.shape
+    sk = k.shape[1]
+    if sq != sk:
+        raise ValueError("folded causal kernel requires sq == sk")
+    if n % bn or sq % b:
+        raise ValueError(f"shape ({n},{sq}) vs blocks (bn={bn},b={b})")
+    nq = sq // b
+    steps = nq * (nq + 1) // 2
+    kernel = functools.partial(_flash_fwd_folded_kernel, b=b, bn=bn,
+                               diag_split=diag_split)
+    params = (None if interpret else pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary")))
+
+    def qmap(bi, t):
+        return (bi, _tri_row(t), 0)
+
+    def kmap(bi, t):
+        qi = _tri_row(t)
+        return (bi, t - qi * (qi + 1) // 2, 0)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n // bn, steps),
+        in_specs=[
+            pl.BlockSpec((bn, b, d), qmap),
+            pl.BlockSpec((bn, b, d), kmap),
+            pl.BlockSpec((bn, b, d), kmap),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, b, d), qmap),
+            pl.BlockSpec((bn, b, 1), qmap),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((n, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bn, b, 1), jnp.float32),
+            pltpu.VMEM((bn, b, 1), jnp.float32),
+            pltpu.VMEM((bn, b, d), jnp.float32),
+        ],
+        compiler_params=params,
+        interpret=interpret,
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# q-grid flash forward — the causal-first variant (round 5, VERDICT r4 #1).
+#
+# This substrate charges ~1.5-2 µs of pipeline overhead per grid step
+# (tools/causal_sweep.py, docs/round5-notes.md), so the (qi, ki) grid pays
+# a k-tile's overhead even for skipped tiles, and causal utilization x
+# per-tile-throughput caps near 37%. Here the grid is (batch, q-tile) ONLY:
+# the whole K/V row sits in VMEM (index map ignores qi, so Mosaic fetches
+# K/V once per head, not once per q-tile), and the kernel walks k-chunks
+# with an in-kernel fori_loop whose trip counts are EXACT for causal —
+# nfull mask-free chunks strictly below the diagonal, then the masked
+# diagonal band, nothing else. No skipped-tile fetch, no per-k-step
+# overhead, no wasted MXU work beyond the diagonal chunk interiors.
+# ---------------------------------------------------------------------------
+def _flash_fwd_qgrid_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                            causal: bool, bq: int, bkc: int, sk: int,
+                            bn: int):
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(1)
+    nkc = sk // bkc
+
+    for j in range(bn):
+        scale = 1.0 / float(q_ref.shape[-1]) ** 0.5
+        q = q_ref[j]
+
+        def chunk(c, carry, masked):
+            m_prev, l_prev, acc_prev = carry
+            k = k_ref[j, pl.ds(c * bkc, bkc)]
+            v = v_ref[j, pl.ds(c * bkc, bkc)]
+            s = _dot_f32(q, k, trans_b=True) * scale
+            if masked:
+                q_pos = qi * bq + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bkc), 0)
+                k_pos = c * bkc + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bkc), 1)
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(s, axis=-1, keepdims=True))
+            if masked:
+                alive = m_new > NEG_INF / 2
+                p = jnp.where(alive, jnp.exp(s - m_new), 0.0)
+                alpha = jnp.where(alive, jnp.exp(m_prev - m_new), 0.0)
+            else:
+                p = jnp.exp(s - m_new)
+                alpha = jnp.exp(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc_prev * alpha + _dot_f32(p.astype(v.dtype), v)
+            return m_new, l_new, acc_new
+
+        init = (jnp.full((bq, 1), NEG_INF, jnp.float32),
+                jnp.zeros((bq, 1), jnp.float32),
+                jnp.zeros((bq, q.shape[-1]), jnp.float32))
+        if causal:
+            # chunks [0, nfull) are strictly below the diagonal; the band
+            # [nfull, nlive) holds the diagonal and is masked
+            nfull = (qi * bq) // bkc
+            nlive = jax.lax.div(qi * bq + bq + bkc - 1, bkc)
+            carry = jax.lax.fori_loop(
+                0, nfull, lambda c, cr: chunk(c, cr, False), init)
+            m, l, acc = jax.lax.fori_loop(
+                nfull, nlive, lambda c, cr: chunk(c, cr, True), carry)
+        else:
+            m, l, acc = jax.lax.fori_loop(
+                0, nkc, lambda c, cr: chunk(c, cr, False), init)
+
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[j] = (acc / safe).astype(o_ref.dtype)
+        lse_ref[j] = jnp.where(l == 0.0, NEG_INF, m + jnp.log(safe))
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "bq", "bkc",
+                                             "interpret", "bn"))
+def _flash_fwd_qgrid(q, k, v, causal: bool, bq: int, bkc: int,
+                     interpret: bool, bn: int = 1):
+    """q-grid forward over [N, S, D]: returns (o, lse). K/V rows resident
+    in VMEM — requires sk*d*(2 dtypes)*bn*2(double-buffer) well under the
+    ~16MB VMEM budget; callers gate on shape."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, sq, d = q.shape
+    sk = k.shape[1]
+    nq = sq // bq
+    if n % bn or sq % bq or sk % bkc:
+        raise ValueError(f"shape ({n},{sq},{sk}) vs blocks "
+                         f"({bn},{bq},{bkc})")
+    kernel = functools.partial(_flash_fwd_qgrid_kernel, causal=causal,
+                               bq=bq, bkc=bkc, sk=sk, bn=bn)
+    params = (None if interpret else pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary")))
+    return pl.pallas_call(
+        kernel,
+        grid=(n // bn, nq),
+        in_specs=[
+            pl.BlockSpec((bn, bq, d), lambda b, qi: (b, qi, 0)),
+            pl.BlockSpec((bn, sk, d), lambda b, qi: (b, 0, 0)),
+            pl.BlockSpec((bn, sk, d), lambda b, qi: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, bq, d), lambda b, qi: (b, qi, 0)),
+            pl.BlockSpec((bn, bq, 1), lambda b, qi: (b, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((n, sq, 1), jnp.float32),
         ],
         compiler_params=params,
         interpret=interpret,
@@ -548,14 +849,28 @@ def _flash_bwd_bhsd(q, k, v, lse, do, delta, q_start, k_start,
     return dq, dk, dv
 
 
+def _flash_fwd_best(q, k, v, causal, bq, bk, interpret):
+    """Forward dispatch (round-5 sweeps, docs/round5-notes.md): causal
+    self-attention takes the folded triangular grid (no skipped steps,
+    ~9% over the rectangular grid); everything else takes the (qi, ki)
+    grid with bn=2 heads per step when the batch divides (74.8% vs 60.8%
+    of peak at the flagship shape)."""
+    n = q.shape[0]
+    if causal and bq == bk and q.shape[1] == k.shape[1]:
+        return _flash_fwd_folded(q, k, v, bq, interpret)
+    # bn=2 at bq=1024 exceeds the 16MB VMEM scoped limit (sweep FAILs)
+    bn = 2 if n % 2 == 0 and bq <= 512 else 1
+    return _flash_fwd_bhsd(q, k, v, causal, bq, bk, interpret, bn)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_mha_diff(q, k, v, causal, bq, bk, interpret):
-    o, _ = _flash_fwd_bhsd(q, k, v, causal, bq, bk, interpret)
+    o, _ = _flash_fwd_best(q, k, v, causal, bq, bk, interpret)
     return o
 
 
 def _flash_mha_diff_fwd(q, k, v, causal, bq, bk, interpret):
-    o, lse = _flash_fwd_bhsd(q, k, v, causal, bq, bk, interpret)
+    o, lse = _flash_fwd_best(q, k, v, causal, bq, bk, interpret)
     return o, (q, k, v, o, lse)
 
 
@@ -606,27 +921,45 @@ def _flash_carry_kernel(pos_ref, q_ref, k_ref, v_ref, m_in, l_in, acc_in,
         l_scr[:] = l_in[:]
         acc_scr[:] = acc_in[:]
 
-    q = q_ref[:]
-    k = k_ref[:]
-    v = v_ref[:]
-    scale = 1.0 / float(q.shape[-1]) ** 0.5
-    s = _dot_f32(q, k, trans_b=True) * scale
+    def _accumulate(masked: bool):
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        scale = 1.0 / float(q.shape[-1]) ** 0.5
+        s = _dot_f32(q, k, trans_b=True) * scale
+        if masked:
+            q_pos = pos_ref[0, 0] + qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_pos = pos_ref[0, 1] + ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        if masked:
+            # rows that have seen nothing but masked scores (whole-hop-in-
+            # the-future blocks) must stay at the identity, not
+            # exp(-inf - -inf) = 1
+            alive = m_new > NEG_INF / 2
+            p = jnp.where(alive, jnp.exp(s - m_new), 0.0)
+            alpha = jnp.where(alive, jnp.exp(m_prev - m_new), 0.0)
+        else:
+            # unmasked tile: m_new is finite, and exp(m_prev - m_new)
+            # underflows to the correct 0 when m_prev is the NEG_INF
+            # "seen nothing yet" sentinel
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + _dot_f32(p.astype(v.dtype), v)
+        m_scr[:] = m_new
+
     if causal:
-        q_pos = pos_ref[0, 0] + qi * bq + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, bk), 0)
-        k_pos = pos_ref[0, 1] + ki * bk + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, bk), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-    m_prev = m_scr[:]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    # rows that have seen nothing but masked scores (whole-hop-in-the-
-    # future blocks) must stay at the identity, not exp(-inf - -inf) = 1
-    alive = m_new > NEG_INF / 2
-    p = jnp.where(alive, jnp.exp(s - m_new), 0.0)
-    alpha = jnp.where(alive, jnp.exp(m_prev - m_new), 0.0)
-    l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * alpha + _dot_f32(p.astype(v.dtype), v)
-    m_scr[:] = m_new
+        # absolute positions: ring hops feed runtime offsets
+        _causal_three_way(
+            pos_ref[0, 0] + qi * bq + bq - 1 >= pos_ref[0, 1] + ki * bk,
+            pos_ref[0, 0] + qi * bq >= pos_ref[0, 1] + ki * bk + bk - 1,
+            _accumulate)
+    else:
+        _accumulate(False)
 
     @pl.when(ki == nk - 1)
     def _finish():

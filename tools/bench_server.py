@@ -43,7 +43,15 @@ def main(argv=None):
     ap.add_argument("--device", action="store_true",
                     help="serve DeviceDataService (this process owns the "
                          "chip; payloads live in HBM, tpu/device_lane.py)")
+    ap.add_argument("--null", action="store_true",
+                    help="answer Echo as the null-service CONTROL: raw "
+                         "body echo from the poll loop, no policy "
+                         "(bench ceiling isolation, VERDICT r4 #2a)")
     args = ap.parse_args(argv)
+    if args.null and not args.native:
+        ap.error("--null requires --native (the control lane lives in "
+                 "the native poll loop; without it you would measure the "
+                 "full-policy path and call it the ceiling)")
     server = Server(ServerOptions(native_dataplane=args.native,
                                   usercode_inline=args.inline))
     server.add_service(EchoServiceImpl())
@@ -54,6 +62,8 @@ def main(argv=None):
     server.start(args.listen)
     if args.native_echo:
         server.register_native_echo("EchoService", "Echo")
+    if args.null:
+        server.register_null_method("EchoService", "Echo")
     print(f"LISTEN {server.listen_endpoint()}", flush=True)
     try:
         sys.stdin.read()  # parent closing the pipe is the stop signal

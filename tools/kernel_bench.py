@@ -43,8 +43,11 @@ def _marginal(fn, lo, hi, reps=4):
 
 
 def bench_flash_attention():
-    """Forward kernel at the headline shape, then the full differentiable
-    fwd+bwd path (the number the train step actually rides)."""
+    """Forward + fwd/bwd at the flagship shape, BOTH causal (the shape the
+    flagship LM trains — VERDICT r4 #1/#4) and non-causal; causal rows use
+    the causal (lower-triangular) flop count. A control row runs the
+    public JAX splash-attention kernel on the same shape so the substrate
+    penalty (per-grid-step overhead, docs/round5-notes.md) is visible."""
     import functools
 
     import jax
@@ -58,58 +61,107 @@ def bench_flash_attention():
     q = jnp.asarray(rng.normal(size=(B, H, S, D)), dtype=jnp.bfloat16)
     k = jnp.asarray(rng.normal(size=(B, H, S, D)), dtype=jnp.bfloat16)
     v = jnp.asarray(rng.normal(size=(B, H, S, D)), dtype=jnp.bfloat16)
+    full_fwd_flops = 4.0 * B * H * S * S * D  # QK^T + PV, 2 flops per MAC
+    causal_fwd_flops = 2.0 * B * H * S * (S + 1) * D
 
-    @functools.partial(jax.jit, static_argnames=("n",))
-    def loop(q, k, v, n: int):
-        def body(i, acc):
-            # acc feeds q so the kernel is NOT loop-invariant; q is tiny
-            # (8MB) next to the compute, unlike the rmsnorm case
-            q2 = q.at[0, 0, 0, 0].add(acc.astype(q.dtype))
-            o = flash_attention_mha(q2, k, v, causal=False,
+    for causal in (True, False):
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def loop(q, k, v, n: int, causal=causal):
+            def body(i, acc):
+                # acc feeds q so the kernel is NOT loop-invariant; q is
+                # tiny (8MB) next to the compute
+                q2 = q.at[0, 0, 0, 0].add(acc.astype(q.dtype))
+                o = flash_attention_mha(q2, k, v, causal=causal,
+                                        interpret=False)
+                return acc + o[0, 0, 0, 0].astype(jnp.float32) * 1e-6
+
+            return jax.lax.fori_loop(0, n, body, jnp.float32(0))
+
+        def run(n, loop=loop):
+            float(jax.device_get(loop(q, k, v, n)))
+
+        sec = _marginal(run, 64, 512)
+        flops = causal_fwd_flops if causal else full_fwd_flops
+        tf = flops / sec / 1e12
+        tag = "CAUSAL (flagship shape)" if causal else "non-causal"
+        print(f"# kernel flash_attention fwd {tag} B={B} H={H} S={S} "
+              f"D={D}: {tf:7.2f} TFLOP/s "
+              f"({tf*1e12/V5E_PEAK_FLOPS*100:.1f}% of v5e bf16 peak)",
+              flush=True)
+
+        def f(q, k, v, causal=causal):
+            o = flash_attention_mha(q, k, v, causal=causal,
                                     interpret=False)
-            return acc + o[0, 0, 0, 0].astype(jnp.float32) * 1e-6
+            return jnp.sum(o.astype(jnp.float32) * 1e-3)
 
-        return jax.lax.fori_loop(0, n, body, jnp.float32(0))
+        g = jax.grad(f, argnums=(0, 1, 2))
 
-    def run(n):
-        float(jax.device_get(loop(q, k, v, n)))
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def loop_bwd(q, k, v, n: int, g=g):
+            def body(i, acc):
+                q2 = q.at[0, 0, 0, 0].add(acc.astype(q.dtype))
+                dq, dk, dv = g(q2, k, v)
+                return acc + (dq[0, 0, 0, 0] + dk[0, 0, 0, 0]
+                              + dv[0, 0, 0, 0]).astype(jnp.float32) * 1e-6
 
-    sec = _marginal(run, 64, 512)
-    flops = 4.0 * B * H * S * S * D  # QK^T + PV, 2 flops per MAC
-    tf = flops / sec / 1e12
-    print(f"# kernel flash_attention fwd B={B} H={H} S={S} D={D}: "
-          f"{tf:7.2f} TFLOP/s "
-          f"({tf*1e12/V5E_PEAK_FLOPS*100:.1f}% of v5e bf16 peak)",
-          flush=True)
+            return jax.lax.fori_loop(0, n, body, jnp.float32(0))
 
-    def f(q, k, v):
-        o = flash_attention_mha(q, k, v, causal=False, interpret=False)
-        return jnp.sum(o.astype(jnp.float32) * 1e-3)
+        def run_bwd(n, loop_bwd=loop_bwd):
+            float(jax.device_get(loop_bwd(q, k, v, n)))
 
-    g = jax.grad(f, argnums=(0, 1, 2))
-
-    @functools.partial(jax.jit, static_argnames=("n",))
-    def loop_bwd(q, k, v, n: int):
-        def body(i, acc):
-            q2 = q.at[0, 0, 0, 0].add(acc.astype(q.dtype))
-            dq, dk, dv = g(q2, k, v)
-            return acc + (dq[0, 0, 0, 0] + dk[0, 0, 0, 0]
-                          + dv[0, 0, 0, 0]).astype(jnp.float32) * 1e-6
-
-        return jax.lax.fori_loop(0, n, body, jnp.float32(0))
-
-    def run_bwd(n):
-        float(jax.device_get(loop_bwd(q, k, v, n)))
-
-    sec = _marginal(run_bwd, 32, 256)
-    # fwd 2 matmuls + bwd 5 matmuls per (q, k) tile pair
-    flops = 7.0 * 2.0 * B * H * S * S * D
-    tf = flops / sec / 1e12
-    print(f"# kernel flash_attention fwd+bwd (custom-vjp Pallas backward): "
-          f"{tf:7.2f} TFLOP/s "
-          f"({tf*1e12/V5E_PEAK_FLOPS*100:.1f}% of v5e bf16 peak)",
-          flush=True)
+        sec = _marginal(run_bwd, 32, 256)
+        # fwd 2 matmuls + bwd 5 matmuls per (q, k) tile pair
+        flops = 3.5 * (causal_fwd_flops if causal else full_fwd_flops)
+        tf = flops / sec / 1e12
+        print(f"# kernel flash_attention fwd+bwd {tag} "
+              f"(custom-vjp Pallas backward): {tf:7.2f} TFLOP/s "
+              f"({tf*1e12/V5E_PEAK_FLOPS*100:.1f}% of v5e bf16 peak)",
+              flush=True)
+    _bench_splash_control(q, k, v, causal_fwd_flops)
     return tf
+
+
+def _bench_splash_control(q, k, v, causal_fwd_flops):
+    """Public-kernel control: jax.experimental splash attention, same
+    shape, causal — shows what the stock TPU kernel does on this
+    substrate (best effort: the module moves between JAX versions)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        from jax.experimental.pallas.ops.tpu.splash_attention import (
+            splash_attention_kernel as sk, splash_attention_mask as sm)
+    except ImportError:
+        return
+    B, H, S, D = q.shape
+    try:
+        mask = sm.MultiHeadMask([sm.CausalMask((S, S))] * H)
+        kernel = sk.make_splash_mha(mask=mask, head_shards=1,
+                                    q_seq_shards=1)
+        f = jax.vmap(lambda q1, k1, v1: kernel(q1 * (D ** -0.5), k1, v1))
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def loop(q, k, v, n: int):
+            def body(i, acc):
+                q2 = q.at[0, 0, 0, 0].add(acc.astype(q.dtype))
+                o = f(q2, k, v)
+                return acc + o[0, 0, 0, 0].astype(jnp.float32) * 1e-6
+
+            return jax.lax.fori_loop(0, n, body, jnp.float32(0))
+
+        def run(n):
+            float(jax.device_get(loop(q, k, v, n)))
+
+        sec = _marginal(run, 16, 128)
+        tf = causal_fwd_flops / sec / 1e12
+        print(f"# control: public jax splash-attention fwd causal, same "
+              f"shape: {tf:7.2f} TFLOP/s "
+              f"({tf*1e12/V5E_PEAK_FLOPS*100:.1f}% of peak)", flush=True)
+    except Exception as e:
+        print(f"# control: splash-attention unavailable "
+              f"({type(e).__name__})", flush=True)
 
 
 def bench_rmsnorm():

@@ -1,0 +1,115 @@
+"""q-grid flash kernel sweep on the real chip (round 5, VERDICT r4 #1).
+
+Same interleaved-median methodology as causal_sweep.py; measures
+_flash_fwd_qgrid (k-loop in kernel, exact causal trip counts) against the
+(qi, ki)-grid kernel's best configs at the flagship shape.
+"""
+
+import functools
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+V5E_PEAK_FLOPS = 197e12
+
+
+def _marginal_once(fn, lo, hi, reps=2):
+    tls, this = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn(lo)
+        tls.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        fn(hi)
+        this.append(time.perf_counter() - t0)
+    return max((min(this) - min(tls)) / (hi - lo), 1e-12)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from brpc_tpu.tpu.pallas_ops import _flash_fwd_qgrid
+
+    B, H, S, D = 4, 8, 2048, 128
+    N = B * H
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(N, S, D)), dtype=jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(N, S, D)), dtype=jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(N, S, D)), dtype=jnp.bfloat16)
+
+    causal_flops = 2.0 * B * H * S * (S + 1) * D
+    full_flops = 4.0 * B * H * S * S * D
+
+    # (causal, bq, bkc, bn)
+    cfgs = [
+        (True, 1024, 512, 1),
+        (True, 1024, 512, 2),
+        (True, 1024, 1024, 1),
+        (True, 1024, 1024, 2),
+        (True, 512, 512, 1),
+        (True, 512, 512, 2),
+        (True, 512, 512, 4),
+        (True, 512, 1024, 2),
+        (True, 2048, 512, 1),
+        (True, 2048, 1024, 1),
+        (False, 1024, 1024, 1),
+        (False, 1024, 1024, 2),
+        (False, 512, 2048, 2),
+        (False, 2048, 1024, 1),
+    ]
+
+    runners = {}
+    for cfg in cfgs:
+        causal, bq, bkc, bn = cfg
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def loop(q, k, v, n: int, bq=bq, bkc=bkc, bn=bn, causal=causal):
+            def body(i, acc):
+                q2 = q.at[0, 0, 0].add(acc.astype(q.dtype))
+                o, _ = _flash_fwd_qgrid(q2, k, v, causal, bq, bkc,
+                                        False, bn)
+                return acc + o[0, 0, 0].astype(jnp.float32) * 1e-6
+
+            return jax.lax.fori_loop(0, n, body, jnp.float32(0))
+
+        def run(n, loop=loop):
+            float(jax.device_get(loop(q, k, v, n)))
+
+        runners[cfg] = run
+
+    ok = {}
+    for cfg, run in runners.items():
+        try:
+            run(64)
+            run(512)
+            ok[cfg] = run
+        except Exception as e:
+            print(f"cfg={cfg}: FAIL {type(e).__name__}: "
+                  f"{str(e).splitlines()[0][:120]}", flush=True)
+
+    secs = {cfg: [] for cfg in ok}
+    for p in range(3):
+        for cfg, run in ok.items():
+            secs[cfg].append(_marginal_once(run, 64, 512))
+        print(f"# pass {p} done", flush=True)
+
+    for cfg in ok:
+        causal, bq, bkc, bn = cfg
+        med = statistics.median(secs[cfg])
+        best = min(secs[cfg])
+        flops = causal_flops if causal else full_flops
+        tfm = flops / med / 1e12
+        tfb = flops / best / 1e12
+        print(f"qgrid causal={int(causal)} bq={bq:5d} bkc={bkc:5d} "
+              f"bn={bn}: median {tfm:7.2f} TF/s "
+              f"({tfm*1e12/V5E_PEAK_FLOPS*100:5.1f}%)  best {tfb:7.2f} "
+              f"({tfb*1e12/V5E_PEAK_FLOPS*100:5.1f}%)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
