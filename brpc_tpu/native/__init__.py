@@ -38,10 +38,14 @@ def _build_flags():
     return flags
 
 
-def _build_so(src: str, stem: str, extra_flags=()) -> str:
-    """Compile src to a digest-named .so next to it; raises on failure."""
-    with open(src, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+def _build_so(src: str, stem: str, extra_flags=(), headers=()) -> str:
+    """Compile src to a digest-named .so next to it; raises on failure.
+    ``headers``: local #includes folded into the cache digest."""
+    sha = hashlib.sha256()
+    for path in (src, *headers):
+        with open(path, "rb") as f:
+            sha.update(f.read())
+    digest = sha.hexdigest()[:16]
     so = os.path.join(_DIR, f"_{stem}_{digest}.so")
     if not os.path.exists(so):
         tmp = f"{so}.{os.getpid()}.tmp"
@@ -116,7 +120,8 @@ def load_dataplane() -> Optional[ctypes.CDLL]:
         if _dp_build_error is not None:
             return None
         try:
-            so = _build_so(_DP_SRC, "dataplane", ("-pthread",))
+            so = _build_so(_DP_SRC, "dataplane", ("-pthread",),
+                           headers=(os.path.join(_DIR, "hpack_tables.h"),))
             lib = ctypes.CDLL(so)
         except (OSError, subprocess.SubprocessError) as e:
             _dp_build_error = f"{type(e).__name__}: {e}"
@@ -153,6 +158,10 @@ def load_dataplane() -> Optional[ctypes.CDLL]:
                                         ctypes.c_int, ctypes.c_int,
                                         ctypes.c_int, ctypes.c_uint32,
                                         ctypes.c_uint32,
+                                        ctypes.POINTER(ctypes.c_int)]
+        lib.dp_connect_grpc.restype = ctypes.c_uint64
+        lib.dp_connect_grpc.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_int, ctypes.c_int,
                                         ctypes.POINTER(ctypes.c_int)]
         lib.dp_listener_set_tpu.restype = ctypes.c_int
         lib.dp_listener_set_tpu.argtypes = [ctypes.c_void_p, ctypes.c_int,

