@@ -378,6 +378,16 @@ def bench_hybrid_native():
                   file=sys.stderr)
         finally:
             srv0.close()
+        # VERDICT r4 #2b lever, on the record: subinterpreter dispatch
+        # cost on this box (nproc=1 -> any dispatch is pure loss)
+        import subprocess as _sp
+
+        out = _sp.run([sys.executable,
+                       os.path.join(REPO, "tools", "subinterp_probe.py")],
+                      capture_output=True, text=True, timeout=120)
+        for line in out.stdout.splitlines():
+            if line.startswith("#"):
+                print(line, file=sys.stderr)
         ch = Channel(ChannelOptions(protocol="trpc_std", timeout_ms=30000,
                                     native_transport=True))
         ch.init(srv.endpoint)

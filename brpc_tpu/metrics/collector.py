@@ -43,6 +43,9 @@ class Collector:
         # lock — under sustained sampling pressure (every RPC asks) nearly
         # all asks hit this branch (GIL-atomic read; small approximation
         # races only ever deny a touch early)
+        # CONTRACT: rpc/server_processing.py's fast path reads _deny_until
+        # directly (one attribute load; any accessor would cost the frames
+        # the read exists to avoid) — keep name + semantics stable
         self._deny_until = 0.0
         self._deferred_denies = 0  # counted outside the Adder on the hot path
         self.grants = Adder()

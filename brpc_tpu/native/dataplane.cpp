@@ -782,16 +782,23 @@ int brpc_code_of_grpc(int g) {
 int parse_grpc_timeout(const std::string& v) {  // -> ms (0 = none)
   if (v.empty()) return 0;
   char unit = v.back();
+  // RFC: at most 8 ASCII digits — also the overflow guard (an attacker-
+  // controlled value must not wrap into a negative/instant deadline)
+  if (v.size() > 9) return 0;
   long long n = atoll(v.substr(0, v.size() - 1).c_str());
+  if (n < 0) return 0;
+  long long ms;
   switch (unit) {
-    case 'H': return int(n * 3600000);
-    case 'M': return int(n * 60000);
-    case 'S': return int(n * 1000);
-    case 'm': return int(n);
-    case 'u': return int(n / 1000);
-    case 'n': return int(n / 1000000);
+    case 'H': ms = n * 3600000; break;
+    case 'M': ms = n * 60000; break;
+    case 'S': ms = n * 1000; break;
+    case 'm': ms = n; break;
+    case 'u': ms = n / 1000; break;
+    case 'n': ms = n / 1000000; break;
     default: return 0;
   }
+  if (ms > 0x7fffffff) ms = 0x7fffffff;
+  return int(ms);
 }
 
 struct H2Stream {
@@ -2358,12 +2365,23 @@ void h2_client_complete(Runtime* rt, const std::shared_ptr<Conn>& c,
   }
   const uint8_t* body = nullptr;
   uint64_t blen = 0;
-  if (code == 0 && st.data.size() >= 5) {
-    uint32_t mlen = ntohl(*reinterpret_cast<const uint32_t*>(
-        st.data.data() + 1));
-    if (uint64_t(mlen) + 5 <= st.data.size()) {
-      body = reinterpret_cast<const uint8_t*>(st.data.data()) + 5;
-      blen = mlen;
+  if (code == 0) {
+    // a grpc-status-0 response MUST carry one well-formed identity
+    // message; a short/truncated/compressed frame is ERESPONSE, not a
+    // silently-empty success (mirrors the server-side rejects)
+    if (st.data.size() < 5 || st.data[0] != 0) {
+      code = 2002;
+      gmsg = "bad grpc response frame";
+    } else {
+      uint32_t mlen = ntohl(*reinterpret_cast<const uint32_t*>(
+          st.data.data() + 1));
+      if (uint64_t(mlen) + 5 > st.data.size()) {
+        code = 2002;
+        gmsg = "grpc response frame truncated";
+      } else {
+        body = reinterpret_cast<const uint8_t*>(st.data.data()) + 5;
+        blen = mlen;
+      }
     }
   }
   c->in_msgs.fetch_add(1, std::memory_order_relaxed);
@@ -2453,23 +2471,23 @@ void h2_emit_stream(H2State* h, uint32_t sid, H2Stream* st,
 }
 
 // Re-try parked streams after a WINDOW_UPDATE / SETTINGS change (loop
-// thread). Flushes the conn's pending batch first so parked continuation
-// bytes cannot overtake frames queued by dp_respond.
+// thread). h->mu is held across the emit AND the write: per-stream frame
+// order is the h->mu acquisition order, so a pump can never overtake the
+// HEADERS+first-chunk a responder emitted under the same lock (pending
+// flushes first for the queued-respond case).
 void h2_pump(Runtime* rt, const std::shared_ptr<Conn>& c) {
   H2State* h = c->h2.get();
   std::string frames;
   std::vector<uint32_t> done;
-  {
-    std::lock_guard<std::mutex> lk(h->mu);
-    for (auto& kv : h->streams) {
-      if (kv.second.out_off < kv.second.out.size() ||
-          !kv.second.trailers.empty()) {
-        h2_emit_stream(h, kv.first, &kv.second, &frames);
-        if (kv.second.sent_all && !h->client) done.push_back(kv.first);
-      }
+  std::lock_guard<std::mutex> lk(h->mu);
+  for (auto& kv : h->streams) {
+    if (kv.second.out_off < kv.second.out.size() ||
+        !kv.second.trailers.empty()) {
+      h2_emit_stream(h, kv.first, &kv.second, &frames);
+      if (kv.second.sent_all && !h->client) done.push_back(kv.first);
     }
-    for (uint32_t sid : done) h->streams.erase(sid);
   }
+  for (uint32_t sid : done) h->streams.erase(sid);
   if (!frames.empty()) {
     flush_conn_pending(rt, c);
     conn_write(rt, c, reinterpret_cast<const uint8_t*>(frames.data()),
@@ -2515,24 +2533,23 @@ int h2_grpc_respond(Runtime* rt, const std::shared_ptr<Conn>& c,
   h2_frame_hdr(&trailers, uint32_t(tb.size()), H2F_HEADERS,
                H2FL_END_HEADERS | H2FL_END_STREAM, sid);
   trailers.append(tb);
-  bool parked = false;
-  {
-    std::lock_guard<std::mutex> lk(h->mu);
-    auto it = h->streams.find(sid);
-    if (it == h->streams.end()) {
-      // stream already gone (client RST / conn teardown): dropping the
-      // response is the h2 contract — resurrecting the sid would send
-      // frames on a closed stream
-      return DPE_OK;
-    }
-    H2Stream& st = it->second;
-    st.out = std::move(msg);
-    st.out_off = 0;
-    st.trailers = std::move(trailers);
-    h2_emit_stream(h, sid, &st, &frames);
-    parked = !st.sent_all;
-    if (!parked) h->streams.erase(it);
+  // h->mu is held through the write/enqueue: a WINDOW_UPDATE pump on the
+  // loop thread must not interleave this stream's continuation ahead of
+  // the HEADERS + first chunk emitted here (lock order: h->mu -> pmu/wmu)
+  std::lock_guard<std::mutex> lk(h->mu);
+  auto it = h->streams.find(sid);
+  if (it == h->streams.end()) {
+    // stream already gone (client RST / conn teardown): dropping the
+    // response is the h2 contract — resurrecting the sid would send
+    // frames on a closed stream
+    return DPE_OK;
   }
+  H2Stream& st = it->second;
+  st.out = std::move(msg);
+  st.out_off = 0;
+  st.trailers = std::move(trailers);
+  h2_emit_stream(h, sid, &st, &frames);
+  if (st.sent_all) h->streams.erase(it);
   if (queue) {
     queue_packet(rt, c, frames, nullptr, 0, nullptr, 0);
     return DPE_OK;
@@ -2579,22 +2596,24 @@ int h2_grpc_call(Runtime* rt, const std::shared_ptr<Conn>& c,
                        size_t(plen));
   if (alen) msg.append(reinterpret_cast<const char*>(att), size_t(alen));
   std::string frames;
-  {
-    std::lock_guard<std::mutex> lk(h->mu);
-    uint32_t sid = h->next_stream_id;
-    h->next_stream_id += 2;
-    h2_frame_hdr(&frames, uint32_t(hb.size()), H2F_HEADERS,
-                 H2FL_END_HEADERS, sid);
-    frames.append(hb);
-    H2Stream& st = h->streams[sid];
-    st.send_window = int64_t(h->peer_initial_window);
-    st.cid = cid;
-    st.headers_done = false;
-    st.out = std::move(msg);
-    st.end_after_out = true;
-    h2_emit_stream(h, sid, &st, &frames);
-    // the stream node survives until the response completes it
-  }
+  // h->mu held from sid allocation through the write/enqueue: RFC 9113
+  // requires monotonically increasing stream ids ON THE WIRE, so the
+  // allocation and the socket handoff must be one atomic step when
+  // several threads share the conn (channel "single" semantics)
+  std::lock_guard<std::mutex> lk(h->mu);
+  uint32_t sid = h->next_stream_id;
+  h->next_stream_id += 2;
+  h2_frame_hdr(&frames, uint32_t(hb.size()), H2F_HEADERS,
+               H2FL_END_HEADERS, sid);
+  frames.append(hb);
+  H2Stream& st = h->streams[sid];
+  st.send_window = int64_t(h->peer_initial_window);
+  st.cid = cid;
+  st.headers_done = false;
+  st.out = std::move(msg);
+  st.end_after_out = true;
+  h2_emit_stream(h, sid, &st, &frames);
+  // the stream node survives until the response completes it
   if (queue) {
     queue_packet(rt, c, frames, nullptr, 0, nullptr, 0);
     return DPE_OK;
@@ -3332,19 +3351,24 @@ void queue_packet(Runtime* rt, const std::shared_ptr<Conn>& c,
 }
 
 int flush_conn_pending(Runtime* rt, const std::shared_ptr<Conn>& c) {
+  // pmu is held ACROSS the write (not just the swap): with the swap
+  // outside, a second flusher racing this one could write newer bytes
+  // before these leave, breaking per-conn FIFO — fatal for h2 streams
+  // (HEADERS must precede their window-parked DATA continuations).
+  // conn_writev is nonblocking (EAGAIN queues to wq), so the hold is
+  // short; lock order pmu -> wmu matches every other path.
+  std::unique_lock<std::mutex> lk(c->pmu);
   std::string out;
   int msgs = 0;
-  {
-    std::lock_guard<std::mutex> lk(c->pmu);
-    out.swap(c->pending);
-    msgs = c->pending_msgs;
-    c->pending_msgs = 0;
-  }
+  out.swap(c->pending);
+  msgs = c->pending_msgs;
+  c->pending_msgs = 0;
   if (out.empty()) return DPE_OK;
   const uint8_t* b[1] = {reinterpret_cast<const uint8_t*>(out.data())};
   const uint64_t l[1] = {out.size()};
   int rc = c->tpu_mode != 0 ? tpu_send_packet(rt, c, b, l, 1)
                             : conn_writev(rt, c, b, l, 1, msgs);
+  lk.unlock();
   if (rc != DPE_OK && !c->failed.load()) {
     // queued responses that can't go out leave callers hanging forever —
     // same contract breach as the native echo path: tear down

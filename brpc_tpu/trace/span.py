@@ -195,3 +195,5 @@ def start_server_span_ids(trace_id: int, parent_span_id: int, service: str,
         return None
     tid = _gen_id()
     return Span(tid, tid, 0, KIND_SERVER, service, method, peer)
+
+

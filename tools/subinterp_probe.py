@@ -1,0 +1,98 @@
+"""Subinterpreter dispatch probe (VERDICT r4 #2b, on the record).
+
+Round 3/4 asked whether a free-threaded CPython or a subinterpreter
+dispatch pool could lift the Python-service lane past its sync-8
+ceiling. This probe measures the actual cost of dispatching a service
+body to a per-interpreter-GIL subinterpreter (PEP 684, Python 3.12
+_xxsubinterpreters) and back, against running it inline.
+
+On this environment the answer is structural before it is mechanical:
+``nproc == 1`` — there is no second core for a second GIL to run on, so
+ANY dispatch overhead is pure loss. The probe quantifies that overhead;
+bench.py prints the result next to the null-service control so the
+negative result is driver-captured, not asserted.
+
+Run standalone: python tools/subinterp_probe.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def probe(n: int = 20000):
+    import _xxsubinterpreters as si
+
+    intp = si.create()
+    # channel-free minimal dispatch: run_string with shared os.pipe fds —
+    # the cheapest cross-interpreter signal available in 3.12
+    r1, w1 = os.pipe()  # main -> sub (request)
+    r2, w2 = os.pipe()  # sub -> main (response)
+    code = f"""
+import os
+while True:
+    b = os.read({r1}, 16)
+    if not b:
+        break
+    os.write({w2}, b)  # the 'service body': echo
+"""
+    import threading
+
+    t = threading.Thread(target=si.run_string, args=(intp, code),
+                         daemon=True)
+    t.start()
+    payload = b"x" * 16
+    # warmup
+    for _ in range(100):
+        os.write(w1, payload)
+        os.read(r2, 16)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        os.write(w1, payload)
+        os.read(r2, 16)
+    sub_us = (time.perf_counter() - t0) / n * 1e6
+
+    def body(b):
+        return b
+
+    t0 = time.perf_counter()
+    for _ in range(n):
+        body(payload)
+    inline_us = (time.perf_counter() - t0) / n * 1e6
+    os.close(w1)   # EOF ends the sub's loop; run_string returns
+    t.join(timeout=5)
+    try:
+        si.destroy(intp)
+    except Exception:
+        pass
+    for fd in (r1, r2, w2):
+        try:
+            os.close(fd)
+        except OSError:
+            pass
+    return sub_us, inline_us
+
+
+def main():
+    cores = os.cpu_count()
+    try:
+        sub_us, inline_us = probe()
+    except Exception as e:
+        print(f"# subinterp probe unavailable: {type(e).__name__}: {e}",
+              flush=True)
+        return 1
+    print(f"# subinterp dispatch probe (PEP-684 pool lever, VERDICT r4 "
+          f"#2b): {sub_us:.1f} us/dispatch round-trip vs {inline_us:.2f} "
+          f"us inline on {cores} core(s) — "
+          + ("a pool ADDS this per request with no second core to win it "
+             "back; the lever is structurally unavailable here"
+             if cores == 1 else
+             "pool viability depends on body length vs this overhead"),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
