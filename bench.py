@@ -382,12 +382,17 @@ def bench_hybrid_native():
         # cost on this box (nproc=1 -> any dispatch is pure loss)
         import subprocess as _sp
 
-        out = _sp.run([sys.executable,
-                       os.path.join(REPO, "tools", "subinterp_probe.py")],
-                      capture_output=True, text=True, timeout=120)
-        for line in out.stdout.splitlines():
-            if line.startswith("#"):
-                print(line, file=sys.stderr)
+        try:
+            out = _sp.run([sys.executable,
+                           os.path.join(REPO, "tools",
+                                        "subinterp_probe.py")],
+                          capture_output=True, text=True, timeout=120)
+            for line in out.stdout.splitlines():
+                if line.startswith("#"):
+                    print(line, file=sys.stderr)
+        except _sp.SubprocessError as e:
+            print(f"# subinterp probe failed: {type(e).__name__}",
+                  file=sys.stderr)
         ch = Channel(ChannelOptions(protocol="trpc_std", timeout_ms=30000,
                                     native_transport=True))
         ch.init(srv.endpoint)
@@ -592,6 +597,41 @@ def bench_device_lane():
               file=sys.stderr)
         print(f"#   Copy op-rate (async dispatch): {copy_rate:,.0f} "
               f"device-op RPC/s", file=sys.stderr)
+        # streaming into HBM (VERDICT r4 #6, tpu/device_stream.py): the
+        # stream's DATA frames carry 16-byte handle records; each record
+        # is consumed as a 1024-round on-device pump; the credit window
+        # counts HBM bytes. Completion = the stream's own cumulative-
+        # consumed feedback reaching the produced total (the flow-control
+        # protocol IS the completion signal).
+        from brpc_tpu.rpc.stream import get_stream, stream_close
+        from brpc_tpu.tpu.device_stream import (open_device_stream,
+                                                send_handle)
+
+        n_recs = 2 if QUICK else 8
+        sid = open_device_stream(
+            srv.endpoint, window_bytes=4 * (copy_mb << 20),
+            channel_options=ChannelOptions(protocol="trpc_std",
+                                           timeout_ms=120000,
+                                           native_transport=True))
+        blk = copy_mb << 20
+        t0 = time.perf_counter()
+        for _ in range(n_recs):
+            rc = send_handle(sid, src, blk, timeout=120)
+            assert rc == 0, f"send_handle rc={rc}"
+        target = n_recs * blk
+        st = get_stream(sid)
+        deadline = time.time() + 300
+        while st._remote_consumed < target and time.time() < deadline:
+            time.sleep(0.005)
+        wall = time.perf_counter() - t0
+        stream_close(sid)
+        assert st._remote_consumed >= target, "stream credits never returned"
+        stream_gbps = n_recs * (2.0 * blk * 1024) / wall / 1e9
+        print(f"#   STREAM->HBM {copy_mb}MB-block records x{n_recs} "
+              f"(1024-round pump per record, credit window in HBM "
+              f"bytes): {stream_gbps:8.1f} GB/s HBM moved "
+              f"({stream_gbps/max(hbm_gbps,1e-9)*100:.0f}% of the Pump "
+              f"lane)", file=sys.stderr)
         return hbm_gbps
     finally:
         srv.close()

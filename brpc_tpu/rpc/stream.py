@@ -43,11 +43,18 @@ class StreamOptions:
                  on_received: Optional[Callable[[int, List[bytes]], None]] = None,
                  on_closed: Optional[Callable[[int], None]] = None,
                  window_bytes: int = DEFAULT_WINDOW,
-                 blocking_write: bool = True):
+                 blocking_write: bool = True,
+                 measure: Optional[Callable[[bytes], int]] = None):
         self.on_received = on_received
         self.on_closed = on_closed
         self.window_bytes = window_bytes
         self.blocking_write = blocking_write
+        # credit unit of a message (None = len). Device streams (SURVEY
+        # §5.7 mapping, tpu/device_stream.py) send tiny HANDLE records
+        # whose credit weight is the HBM bytes they name — the window
+        # then bounds device-pool occupancy, not wire bytes. Both ends
+        # must agree on the measure.
+        self.measure = measure
 
 
 class Stream:
@@ -108,7 +115,8 @@ class Stream:
             return errors.ERPCTIMEDOUT
         if self.closed:
             return errors.ESTREAMCLOSED
-        n = len(data)
+        n = (len(data) if self.options.measure is None
+             else self.options.measure(data))
         with self._write_lock:
             # block only while bytes are in flight: a message larger than
             # the whole window must still be sendable once the window is
@@ -170,7 +178,9 @@ class Stream:
                 return
             self._recv_seq_expect += 1
             msgs.append(payload)
-            self._consumed += len(payload)
+            self._consumed += (len(payload)
+                               if self.options.measure is None
+                               else self.options.measure(payload))
         if self.options.on_received is not None:
             try:
                 self.options.on_received(self.stream_id, msgs)
@@ -179,10 +189,19 @@ class Stream:
         self._maybe_feedback()
 
     def _maybe_feedback(self) -> None:
+        if self._consumed - self._feedback_sent >= self.peer_window // 2:
+            self.flush_feedback()
+
+    def flush_feedback(self) -> None:
+        """Send cumulative-consumed feedback NOW (not just at the
+        half-window pacing mark). Heavy-consumption receivers (device
+        streams: one on-device op per record) call this after each
+        delivery batch so a producer's credit accounting converges to
+        the exact consumed total — credit equality then doubles as a
+        completion signal (tpu/device_stream.py)."""
         from brpc_tpu.policy.trpc_stream import pack_stream_frame
 
-        if (self._consumed - self._feedback_sent
-                >= self.peer_window // 2) and self.socket is not None:
+        if self._consumed > self._feedback_sent and self.socket is not None:
             meta = self._frame_meta(FRAME_FEEDBACK)
             meta.consumed_bytes = self._consumed
             self._feedback_sent = self._consumed

@@ -1,0 +1,131 @@
+"""Streaming RPC -> device lane (VERDICT r4 #6): handle records ride the
+stream, payload stays in HBM (the test substrate's virtual device), the
+credit window bounds DEVICE-POOL OCCUPANCY, and consumption is on-device.
+Reference semantics: stream.cpp:318,354,631 credit protocol."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from brpc_tpu.rpc import Server
+from brpc_tpu.rpc.stream import get_stream, stream_close
+from brpc_tpu.tpu.device_lane import DeviceStore
+from brpc_tpu.tpu.device_stream import (DeviceStreamEchoService,
+                                        open_device_stream, pack_record,
+                                        record_measure, send_handle)
+
+
+@pytest.fixture()
+def device_stream_server():
+    store = DeviceStore()
+    impl = DeviceStreamEchoService(store)
+    server = Server().add_service(impl).start("127.0.0.1:0")
+    yield server, impl, store
+    server.stop()
+    server.join(timeout=2)
+
+
+class TestDeviceStream:
+    def test_records_measure_hbm_bytes(self):
+        rec = pack_record(7, 1 << 20) + pack_record(9, 4096)
+        assert record_measure(rec) == (1 << 20) + 4096
+
+    def test_blocks_flow_and_are_consumed_on_device(self,
+                                                    device_stream_server):
+        server, impl, store = device_stream_server
+        sid = open_device_stream(str(server.listen_endpoint()),
+                                 window_bytes=1 << 20)
+        try:
+            total = 0
+            for i in range(8):
+                data = bytes([i]) * 4096
+                h, n = store.put(data)
+                assert send_handle(sid, h, n) == 0
+                total += n
+            deadline = time.monotonic() + 5
+            while impl.consumed_blocks < 8 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert impl.consumed_blocks == 8
+            assert impl.consumed_bytes == total
+            assert impl.errors == 0
+            # consumed blocks were freed: residency returns to zero
+            store.fence()
+            count, resident, moved = store.stats()
+            assert count == 0, (count, resident)
+            # and the consume MOVED the bytes on-device (transient copy)
+            assert moved >= total
+        finally:
+            stream_close(sid)
+
+    def test_window_bounds_hbm_occupancy(self, device_stream_server):
+        """The writer must stall when the receiver holds `window` bytes
+        of unconsumed blocks — the §5.7 credit semantics with HBM
+        occupancy as the unit."""
+        server, impl, store = device_stream_server
+        block = 256 * 1024
+        window = 2 * block  # at most 2 unconsumed blocks in flight
+        # gate consumption so blocks pile up at the receiver
+        gate = threading.Event()
+        orig_consume = impl._consume
+
+        def gated_consume(h, n):
+            gate.wait(10)
+            orig_consume(h, n)
+
+        impl._consume = gated_consume
+        sid = open_device_stream(str(server.listen_endpoint()),
+                                 window_bytes=window)
+        try:
+            sent = []
+
+            def producer():
+                for i in range(5):
+                    h, n = store.put(bytes([i]) * block)
+                    rc = send_handle(sid, h, n, timeout=20)
+                    sent.append((time.monotonic(), rc))
+
+            t = threading.Thread(target=producer)
+            t.start()
+            time.sleep(0.8)
+            # window = 2 blocks -> writes 1..2 pass, write 3+ is parked
+            # (the 3rd may pass the in-flight check edge; assert <= 3)
+            n_before = len(sent)
+            assert 2 <= n_before <= 3, sent
+            gate.set()  # consumer drains; credits return; writer resumes
+            t.join(timeout=20)
+            assert len(sent) == 5 and all(rc == 0 for _, rc in sent), sent
+            deadline = time.monotonic() + 5
+            while impl.consumed_blocks < 5 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert impl.consumed_blocks == 5
+        finally:
+            stream_close(sid)
+
+    def test_payload_integrity_through_hbm(self, device_stream_server):
+        """End-to-end bit check: producer stages bytes, consumer copies
+        on-device into a persistent handle, host verifies via get()."""
+        server, impl, store = device_stream_server
+        kept = []
+        orig_consume = impl._consume
+
+        def keeping_consume(h, n):
+            out = store.copy(h)  # persistent copy, keeps the bytes
+            kept.append(out[0])
+            store.free(h)
+
+        impl._consume = keeping_consume
+        sid = open_device_stream(str(server.listen_endpoint()))
+        try:
+            payload = np.random.default_rng(3).integers(
+                0, 256, size=65536, dtype=np.uint8).tobytes()
+            h, n = store.put(payload)
+            assert send_handle(sid, h, n) == 0
+            deadline = time.monotonic() + 5
+            while not kept and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert kept
+            assert store.get(kept[0]) == payload
+        finally:
+            stream_close(sid)

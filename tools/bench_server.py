@@ -23,7 +23,16 @@ from brpc_tpu.rpc import Server, ServerOptions, Service  # noqa: E402
 class EchoServiceImpl(Service):
     DESCRIPTOR = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
 
+    def __init__(self, device_stream_impl=None):
+        super().__init__()
+        # --device mode: "device-stream[:window]" Echo requests open a
+        # streaming-into-HBM stream (tpu/device_stream.py) on this port
+        self.device_stream_impl = device_stream_impl
+
     def Echo(self, cntl, request, done):
+        if (self.device_stream_impl is not None
+                and request.message.startswith("device-stream")):
+            return self.device_stream_impl.Echo(cntl, request, done)
         cntl.response_attachment = cntl.request_attachment
         return echo_pb2.EchoResponse(message=request.message,
                                      payload=request.payload)
@@ -54,11 +63,19 @@ def main(argv=None):
                  "full-policy path and call it the ceiling)")
     server = Server(ServerOptions(native_dataplane=args.native,
                                   usercode_inline=args.inline))
-    server.add_service(EchoServiceImpl())
+    stream_impl = None
     if args.device:
         from brpc_tpu.tpu.device_lane import DeviceDataService
+        from brpc_tpu.tpu.device_stream import DeviceStreamEchoService
 
-        server.add_service(DeviceDataService())
+        dds = DeviceDataService()
+        server.add_service(dds)
+        # streaming-into-HBM lane (tpu/device_stream.py): blocks arrive
+        # by reference, consumption = heavy on-device pump, block kept
+        # resident so the bench can stream it repeatedly
+        stream_impl = DeviceStreamEchoService(dds.store, rounds=1024,
+                                              free_after=False)
+    server.add_service(EchoServiceImpl(device_stream_impl=stream_impl))
     server.start(args.listen)
     if args.native_echo:
         server.register_native_echo("EchoService", "Echo")
