@@ -98,8 +98,20 @@ def _make_ring_flash(axis, n, fwd, causal, block_q, block_k, vaxes,
 
             kt = k_cur.transpose(0, 2, 1, 3)
             vt = v_cur.transpose(0, 2, 1, 3)
-            mt, lt, at = jax.vmap(jax.vmap(one_head))(qt, kt, vt, mt, lt,
-                                                      at)
+            if causal:
+                # a KV block entirely in this shard's future contributes
+                # nothing: skip the kernel launch, keep the carry (the
+                # kernels would skip every tile anyway, but the launch +
+                # VMEM streaming of dead blocks is real wall clock —
+                # lax.cond picks the identity at runtime per device)
+                mt, lt, at = lax.cond(
+                    k_start <= q_start + sq - 1,
+                    lambda ops: jax.vmap(jax.vmap(one_head))(*ops),
+                    lambda ops: (ops[3], ops[4], ops[5]),
+                    (qt, kt, vt, mt, lt, at))
+            else:
+                mt, lt, at = jax.vmap(jax.vmap(one_head))(qt, kt, vt, mt,
+                                                          lt, at)
             return (lax.ppermute(k_cur, axis, fwd),
                     lax.ppermute(v_cur, axis, fwd), at, mt, lt)
 
@@ -130,10 +142,25 @@ def _make_ring_flash(axis, n, fwd, causal, block_q, block_k, vaxes,
             k_start = src * sk
             kb = k_cur.transpose(0, 2, 1, 3).reshape(B * H, sk, D)
             vb = v_cur.transpose(0, 2, 1, 3).reshape(B * H, sk, D)
-            dq_b, dk_b, dv_b = _flash_bwd_bhsd(
-                qb, kb, vb, lseb, dob, deltab, q_start, k_start, causal,
-                _fit_block(sq, block_q), _fit_block(sk, block_k), interp,
-                vma=vma)
+
+            def run_bwd(ops):
+                qb2, kb2, vb2 = ops
+                return _flash_bwd_bhsd(
+                    qb2, kb2, vb2, lseb, dob, deltab, q_start, k_start,
+                    causal, _fit_block(sq, block_q),
+                    _fit_block(sk, block_k), interp, vma=vma)
+
+            if causal:
+                # fully-future KV block: dq/dk/dv contributions are
+                # identically zero — skip both backward kernels
+                zero_q = jnp.zeros((B * H, sq, D), qb.dtype)
+                zero_kv = jnp.zeros((B * H, sk, D), kb.dtype)
+                dq_b, dk_b, dv_b = lax.cond(
+                    k_start <= q_start + sq - 1, run_bwd,
+                    lambda ops: (zero_q, zero_kv, zero_kv),
+                    (qb, kb, vb))
+            else:
+                dq_b, dk_b, dv_b = run_bwd((qb, kb, vb))
             dq_acc = dq_acc + dq_b.astype(jnp.float32)
             dk_cur = dk_cur + dk_b.reshape(B, H, sk, D).transpose(
                 0, 2, 1, 3).astype(jnp.float32)

@@ -205,6 +205,9 @@ def bench_ring_path():
 
             for hop in range(SP):
                 src = (d - hop) % SP
+                if src > d:
+                    continue  # fully-future KV block: the ring's lax.cond
+                    # skips the launch (tpu/ring.py); static here
                 kb = k[:, :, src * SQ:(src + 1) * SQ]
                 vb = v[:, :, src * SQ:(src + 1) * SQ]
                 m, l, acc = jax.vmap(jax.vmap(
@@ -234,6 +237,8 @@ def bench_ring_path():
             dq_acc = jnp.zeros((B * H, SQ, D), jnp.float32)
             for hop in range(SP):
                 src = (d - hop) % SP
+                if src > d:
+                    continue  # fully-future block: zero gradients
                 kb = k[:, :, src * SQ:(src + 1) * SQ].reshape(
                     B * H, SQ, D)
                 vb = v[:, :, src * SQ:(src + 1) * SQ].reshape(
