@@ -1,0 +1,42 @@
+"""Streaming-into-HBM server (tpu/device_stream.py, SURVEY §5.7).
+
+Accepts device streams on the Echo RPC: each incoming 16-byte handle
+record is consumed ON-DEVICE (transient copy) and freed, and credits
+flow back through the stream's feedback — the credit window bounds this
+process's device-pool occupancy.
+
+    python examples/device_stream/server.py [--listen 127.0.0.1:8310]
+"""
+
+import argparse
+import signal
+import sys
+
+from brpc_tpu.rpc import Server
+from brpc_tpu.tpu.device_lane import DeviceDataService
+from brpc_tpu.tpu.device_stream import DeviceStreamEchoService
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--listen", default="127.0.0.1:8310")
+    args = ap.parse_args(argv)
+    server = Server()
+    dds = DeviceDataService()
+    server.add_service(dds)
+    server.add_service(DeviceStreamEchoService(dds.store))
+    server.start(args.listen)
+    print(f"device-stream server on {server.listen_endpoint()}",
+          flush=True)
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    while not stop:
+        signal.pause()
+    server.stop()
+    server.join()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

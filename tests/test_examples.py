@@ -113,6 +113,28 @@ class TestSingleFileExamples:
                          ["--sizes", "4096,65536", "-n", "4"])
         assert "MB/s" in out
 
+    def test_device_stream(self):
+        srv = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "examples",
+                                          "device_stream", "server.py"),
+             "--listen", "127.0.0.1:0"],
+            env=ENV, stdout=subprocess.PIPE, text=True)
+        try:
+            line = srv.stdout.readline()
+            addr = line.split(" on ", 1)[1].strip()
+            client = subprocess.run(
+                [sys.executable, os.path.join(REPO, "examples",
+                                              "device_stream",
+                                              "client.py"),
+                 "--server", addr, "-n", "4", "--block-kb", "64",
+                 "--window-kb", "128"],
+                env=ENV, capture_output=True, text=True, timeout=120)
+            assert client.returncode == 0, client.stdout + client.stderr
+            assert "consumed on-device" in client.stdout
+        finally:
+            srv.terminate()
+            srv.wait()
+
     def test_device_data(self):
         srv = subprocess.Popen(
             [sys.executable, os.path.join(REPO, "examples", "device_data",
