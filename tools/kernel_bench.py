@@ -356,7 +356,12 @@ def bench_train_step_mfu():
     def measure(cfg, batch=B, hi=12):
         # hi sets the measured work: at ~50ms/step the slope needs ~600ms
         # of marginal work to dominate the relay's ~100ms sync noise
-        # (earlier hi=5 runs swung the reported MFU by +-8 points)
+        # (earlier hi=5 runs swung the reported MFU by +-8 points).
+        # Round 5 (VERDICT r4 #4): the published number is the MEDIAN of
+        # three marginal estimates — single passes still swung the
+        # kernels-on MFU by ~6 points between bench runs.
+        import statistics
+
         params = train.init_params(jax.random.PRNGKey(0), cfg)
         tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, S), 0,
                                     cfg.vocab)
@@ -377,7 +382,7 @@ def bench_train_step_mfu():
             out = steps(params, tokens, n)
             jax.device_get(jax.tree.leaves(out)[0][:1])  # dependent fetch
 
-        sec = _marginal(run, 1, hi)
+        sec = statistics.median(_marginal(run, 1, hi) for _ in range(3))
         matmul_params = (cfg.n_layers * (cfg.d_model * 3 * cfg.d_model
                                          + cfg.d_model * cfg.d_model
                                          + 2 * cfg.d_model * cfg.d_ff)
