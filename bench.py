@@ -21,7 +21,9 @@ Channel -> tpu:// transport -> Server stack, vs the reference's 2.3 GB/s
 loopback plateau (/root/reference/docs/cn/benchmark.md:104).
 
 Env knobs: BENCH_QUICK=1 shortens every phase (CI smoke); BENCH_SKIP_DEVICE=1
-skips the jax probe.
+skips the jax probe; BENCH_PHASES=shm,qps,native,hybrid,device runs only the
+named phases (default: all) — e.g. BENCH_PHASES=shm is the CPU-only tier-1
+smoke lane, whose headline is then the Python tpu:// sweep.
 """
 
 from __future__ import annotations
@@ -35,6 +37,12 @@ import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 QUICK = os.environ.get("BENCH_QUICK") == "1"
+PHASES = {p.strip() for p in os.environ.get("BENCH_PHASES", "").split(",")
+          if p.strip()}
+
+
+def _phase_enabled(name: str) -> bool:
+    return not PHASES or name in PHASES
 BASELINE_GBPS = 2.3       # reference docs/cn/benchmark.md:104 plateau
 HEADLINE_SIZE = 1 << 20
 
@@ -154,9 +162,16 @@ def bench_tpu_sweep():
     Returns the 1MB aggregate bandwidth in GB/s (the headline)."""
     from brpc_tpu.proto import echo_pb2
     from brpc_tpu.rpc import Channel, ChannelOptions, Stub
+    from brpc_tpu.tpu.transport import (g_tunnel_ack_credits,
+                                        g_tunnel_ack_frames,
+                                        g_tunnel_borrowed_bytes,
+                                        g_tunnel_copied_bytes)
 
     srv = _BenchServer("tpu://127.0.0.1:0/0")
     headline = 0.0
+    zc0 = (g_tunnel_borrowed_bytes.get_value(),
+           g_tunnel_copied_bytes.get_value(),
+           g_tunnel_ack_frames.get_value(), g_tunnel_ack_credits.get_value())
     try:
         ch = Channel(ChannelOptions(protocol="trpc_std", timeout_ms=60000))
         ch.init(srv.endpoint)
@@ -186,6 +201,20 @@ def bench_tpu_sweep():
                   f"p99={_percentile(lats,0.99)*1e3:7.2f}ms", file=sys.stderr)
             if size == HEADLINE_SIZE:
                 headline = gbps
+        borrowed = g_tunnel_borrowed_bytes.get_value() - zc0[0]
+        copied = g_tunnel_copied_bytes.get_value() - zc0[1]
+        frames = g_tunnel_ack_frames.get_value() - zc0[2]
+        credits = g_tunnel_ack_credits.get_value() - zc0[3]
+        total = borrowed + copied
+        print(f"# tpu:// zero-copy receive (this process = client side): "
+              f"borrowed={borrowed:,}B copied={copied:,}B "
+              f"({borrowed / total:.0%} borrowed)" if total else
+              "# tpu:// zero-copy receive: no block-segment traffic",
+              file=sys.stderr)
+        if frames:
+            print(f"# tpu:// ack batching: {credits:,} credits in "
+                  f"{frames:,} FT_ACK frames "
+                  f"({credits / frames:.1f} credits/frame)", file=sys.stderr)
         return headline
     finally:
         srv.close()
@@ -667,14 +696,19 @@ def bench_device_probe():
 
 
 def main() -> None:
-    bench_multi_threaded_echo()
-    native_1mb = bench_native_lane()
-    tpu_1mb = bench_native_tpu_lane()
+    if _phase_enabled("qps"):
+        bench_multi_threaded_echo()
+    native_1mb = tpu_1mb = None
+    if _phase_enabled("native"):
+        native_1mb = bench_native_lane()
+        tpu_1mb = bench_native_tpu_lane()
     if native_1mb is not None and tpu_1mb is not None:
         native_1mb = max(native_1mb, tpu_1mb)
-    bench_hybrid_native()
-    py_1mb = bench_tpu_sweep()
-    if os.environ.get("BENCH_SKIP_DEVICE") != "1":
+    if _phase_enabled("hybrid"):
+        bench_hybrid_native()
+    py_1mb = bench_tpu_sweep() if _phase_enabled("shm") else None
+    if os.environ.get("BENCH_SKIP_DEVICE") != "1" and \
+            _phase_enabled("device"):
         try:
             bench_device_lane()
         except Exception as e:  # diagnostics must never sink the bench
@@ -696,14 +730,15 @@ def main() -> None:
                           f"{' | '.join(tail)}", file=sys.stderr)
             except Exception as e:
                 print(f"# kernel bench skipped: {e}", file=sys.stderr)
-    if os.environ.get("BENCH_SKIP_DEVICE") != "1" and not QUICK:
+    if os.environ.get("BENCH_SKIP_DEVICE") != "1" and not QUICK \
+            and _phase_enabled("device"):
         try:
             bench_device_probe()
         except Exception as e:  # diagnostics must never sink the bench
             print(f"# device probe skipped: {e}", file=sys.stderr)
     # headline: the framework's fastest supported lane (native when built,
     # like the reference's C++ stack; Python tpu:// sweep otherwise)
-    headline = native_1mb if native_1mb is not None else py_1mb
+    headline = native_1mb if native_1mb is not None else (py_1mb or 0.0)
     print(json.dumps({
         "metric": "echo_1mb_framework_bandwidth",
         "value": round(headline, 3),

@@ -12,9 +12,46 @@ block source lets pinned-host buffers back blocks later (the reference's RDMA
 from __future__ import annotations
 
 from collections import deque
-from typing import Iterable, Iterator, List, Optional, Tuple
+from typing import Callable, Iterable, Iterator, List, Optional, Tuple
+
+try:  # numpy backs the owned-block exporter; gate, don't require
+    import numpy as _np
+except Exception:  # pragma: no cover - numpy ships with the jax toolchain
+    _np = None
 
 DEFAULT_BLOCK_SIZE = 8192
+
+
+if _np is not None:
+
+    class _OwnedBlock(_np.ndarray):
+        """Buffer exporter that runs a release hook when the LAST memoryview
+        over it dies (the reference's iobuf block refcount, done with the
+        CPython refcount: every slice/re-wrap of a memoryview keeps its
+        exporter alive through ``Py_buffer.obj``, so the hook fires exactly
+        when no live view can read the block anymore — however the views
+        were split by ``cutn``/``pop_front`` or queued for a socket write).
+        """
+
+        _release: Optional[Callable[[], None]] = None
+
+        def __del__(self):
+            cb = self._release
+            if cb is not None:
+                self._release = None
+                try:
+                    cb()
+                except Exception:
+                    pass
+
+else:  # pragma: no cover - degraded environment without numpy
+    _OwnedBlock = None
+
+
+def supports_block_ownership() -> bool:
+    """True when append_user_data(..., release=) can defer the release to
+    actual consumption instead of copying eagerly."""
+    return _OwnedBlock is not None
 
 
 class IOBuf:
@@ -69,13 +106,50 @@ class IOBuf:
         """Append a private copy (when the caller will mutate its buffer)."""
         self.append(bytes(data))
 
-    def append_user_data(self, mv: memoryview) -> None:
+    def append_user_data(self, mv: memoryview,
+                         release: Optional[Callable[[], None]] = None) -> bool:
         """Append a caller-owned block without copy.
 
-        Mirrors ``append_user_data_with_meta`` (reference iobuf.h) used for
-        registered/pinned memory on the zero-copy path.
+        Mirrors ``append_user_data_with_meta`` (reference iobuf.h:141) used
+        for registered/pinned memory on the zero-copy path. With ``release``,
+        the block is wrapped in a refcounted exporter and the callback fires
+        exactly once, when the last live view over the block dies — i.e. when
+        ``cutn``/``pop_front``/``clear`` consumption (or any downstream
+        holder: a parsed message body, a socket write queue) has let go of
+        every byte. Returns True when the append was zero-copy with deferred
+        release; False when the environment forced a private copy (release
+        already ran — the caller may reuse the buffer immediately).
         """
-        self.append(mv)
+        if release is None:
+            self.append(mv)
+            return True
+        if not isinstance(mv, memoryview):
+            mv = memoryview(mv)
+        if mv.nbytes == 0:
+            release()
+            return True
+        if _OwnedBlock is None:
+            # no exporter available: keep the CONTRACT (caller may recycle
+            # the block once release ran) by copying, then releasing now
+            self.append(bytes(mv))
+            release()
+            return False
+        blk = _np.frombuffer(mv, dtype=_np.uint8).view(_OwnedBlock)
+        blk._release = release
+        self.append(memoryview(blk))
+        return True
+
+    def has_owned_blocks(self) -> bool:
+        """True if any ref aliases a release-tracked block (borrowed
+        registered memory): wholesale snapshot copies of such a buffer
+        defeat the zero-copy receive path, so batch cutters bail to the
+        ref-moving parse path when this holds."""
+        if _OwnedBlock is None:
+            return False
+        for mv in self._refs:
+            if type(mv.obj) is _OwnedBlock:
+                return True
+        return False
 
     # ------------------------------------------------------------------- cut
     def cutn(self, n: int) -> "IOBuf":

@@ -119,12 +119,21 @@ class TrpcStdProtocol(Protocol):
     # --------------------------------------------------------------- helpers
     @staticmethod
     def split_attachment(msg: ParsedMessage) -> Tuple[bytes, bytes]:
-        """body -> (serialized message bytes, attachment bytes)."""
+        """body -> (serialized message bytes, attachment bytes).
+
+        Splits by ref first (cutn), so each side is materialized exactly
+        once — flatten-then-slice copied an attachment'd body twice, and on
+        the tpu tunnel's zero-copy receive path the body refs are borrowed
+        registered blocks whose flow-control credit returns when these
+        copies drop the refs."""
         att_size = msg.meta.attachment_size
-        body = msg.body.tobytes()
-        if att_size:
-            return body[:-att_size], body[-att_size:]
-        return body, b""
+        body = msg.body
+        if att_size and att_size <= len(body):
+            payload = body.cutn(len(body) - att_size).tobytes()
+            return payload, body.cutn(att_size).tobytes()
+        data = body.tobytes()
+        body.clear()  # drop refs now, not at message GC
+        return data, b""
 
     @staticmethod
     def verify_checksum(meta, payload: bytes) -> bool:

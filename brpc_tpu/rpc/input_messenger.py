@@ -119,29 +119,39 @@ class InputMessenger:
         e.g. stream frames, re-serialize in their own ExecutionQueue)."""
         count = 0
         server = self._server
-        while len(sock.read_buf):
-            batch = self._cut_batch_native(sock)
-            if batch:
-                msgs = batch
-            else:
-                msg = self._cut_one(sock)
-                if msg is None:
-                    break
-                msgs = (msg,)
-            for msg in msgs:
-                msg.socket = sock
-                sock.in_messages += 1
-                count += 1
-                cid = msg.protocol.claim_cid(msg)
-                if cid is not None:
-                    sock.remove_pending_id(cid)
-                if msg.protocol.inline_process:
-                    # order-sensitive frames (streams): handle on the serial
-                    # parse loop; the handler only enqueues to per-stream
-                    # queues
-                    _process_one(msg, server)
+        # transports that defer flow-control credits (the tpu tunnel's
+        # borrowed registered blocks) bracket the cut loop so every credit
+        # released while this batch parses coalesces into one ACK frame
+        batch_hook = getattr(sock, "cut_batch_hook", None)
+        if batch_hook is not None:
+            batch_hook.cut_batch_begin()
+        try:
+            while len(sock.read_buf):
+                batch = self._cut_batch_native(sock)
+                if batch:
+                    msgs = batch
                 else:
-                    runtime.start_background(_process_one, msg, server)
+                    msg = self._cut_one(sock)
+                    if msg is None:
+                        break
+                    msgs = (msg,)
+                for msg in msgs:
+                    msg.socket = sock
+                    sock.in_messages += 1
+                    count += 1
+                    cid = msg.protocol.claim_cid(msg)
+                    if cid is not None:
+                        sock.remove_pending_id(cid)
+                    if msg.protocol.inline_process:
+                        # order-sensitive frames (streams): handle on the
+                        # serial parse loop; the handler only enqueues to
+                        # per-stream queues
+                        _process_one(msg, server)
+                    else:
+                        runtime.start_background(_process_one, msg, server)
+        finally:
+            if batch_hook is not None:
+                batch_hook.cut_batch_end()
         return count
 
     def _cut_batch_native(self, sock: Socket):
@@ -158,6 +168,11 @@ class InputMessenger:
             return None
         buf = sock.read_buf
         if len(buf) < 12:
+            return None
+        if buf.has_owned_blocks():
+            # borrowed registered-block views (tpu tunnel zero-copy receive)
+            # must move by ref through the generic cut path — this path's
+            # wholesale fetch() snapshot would re-copy the whole payload
             return None
         # cheap peek: don't snapshot a big buffer that holds only one
         # still-incomplete frame (a large payload arriving in chunks would

@@ -148,7 +148,11 @@ class Socket:
         if isinstance(data, IOBuf):
             views = list(data.iter_blocks())
             data.clear()
-        elif isinstance(data, (bytes, bytearray)):
+        elif isinstance(data, bytes):
+            # immutable: safe to alias until the kernel send drains it
+            views = [memoryview(data)]
+        elif isinstance(data, bytearray):
+            # caller may mutate/shrink after write returns — snapshot
             views = [memoryview(bytes(data))]
         else:
             views = [data]

@@ -25,7 +25,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax >= 0.5 re-exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # older jax (0.4.x): experimental home
+    from jax.experimental.shard_map import shard_map
 
 
 # ------------------------------------------------------------------ wrappers

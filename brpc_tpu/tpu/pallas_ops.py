@@ -15,6 +15,18 @@ import jax.numpy as jnp
 
 EPS = 1e-6
 
+try:  # jax >= 0.7 types out_shape with varying mesh axes
+    jax.ShapeDtypeStruct((), jnp.float32, vma=None)
+    _SDS_HAS_VMA = True
+except TypeError:  # jax 0.4.x: no varying-axes types, drop the annotation
+    _SDS_HAS_VMA = False
+
+
+def _sds(shape, dtype, vma=None):
+    if _SDS_HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
 
 def _on_tpu() -> bool:
     try:
@@ -812,7 +824,7 @@ def _flash_bwd_bhsd(q, k, v, lse, do, delta, q_start, k_start,
             pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, sq, d), q.dtype, vma=vset),
+        out_shape=_sds((n, sq, d), q.dtype, vma=vset),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=params,
         interpret=interpret,
@@ -836,8 +848,8 @@ def _flash_bwd_bhsd(q, k, v, lse, do, delta, q_start, k_start,
             pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, sk, d), k.dtype, vma=vset),
-            jax.ShapeDtypeStruct((n, sk, d), v.dtype, vma=vset),
+            _sds((n, sk, d), k.dtype, vma=vset),
+            _sds((n, sk, d), v.dtype, vma=vset),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -1017,12 +1029,12 @@ def flash_attention_carry(q, k, v, m, l, acc, q_start, k_start,
             pl.BlockSpec((bq, d), lambda qi, ki: (qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((sq, 1), jnp.float32,
-                                 vma=set(vma) if vma else None),
-            jax.ShapeDtypeStruct((sq, 1), jnp.float32,
-                                 vma=set(vma) if vma else None),
-            jax.ShapeDtypeStruct((sq, d), jnp.float32,
-                                 vma=set(vma) if vma else None),
+            _sds((sq, 1), jnp.float32,
+                 vma=set(vma) if vma else None),
+            _sds((sq, 1), jnp.float32,
+                 vma=set(vma) if vma else None),
+            _sds((sq, d), jnp.float32,
+                 vma=set(vma) if vma else None),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),

@@ -21,7 +21,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax >= 0.5 re-exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # older jax (0.4.x): experimental home + old kwarg name
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, /, *, check_vma=True, **kw):
+        return _exp_shard_map(f, check_rep=check_vma, **kw)
 
 NEG_INF = -1e30
 
@@ -32,7 +39,9 @@ def _pvary(x, axes):
     warning (VERDICT r4 weak #7)."""
     if hasattr(lax, "pcast"):
         return lax.pcast(x, axes, to="varying")
-    return lax.pvary(x, axes)
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axes)
+    return x  # jax 0.4.x: no varying-axes types, marking is a no-op
 
 
 def _block_attend(q, k, v, o, m, l, mask):

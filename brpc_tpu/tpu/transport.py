@@ -39,6 +39,7 @@ window accounting, and virtual-socket delivery are transport-independent.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import secrets
@@ -74,6 +75,7 @@ FT_ACK = 4        # return block credits
 FT_BYE = 5        # orderly shutdown
 
 DATA_BODY_HDR = "!II"         # inline_len, nsegs
+DATA_BODY_HDR_SIZE = struct.calcsize(DATA_BODY_HDR)
 SEG_FMT = "!II"               # block index, length
 _SEG_SIZE = struct.calcsize(SEG_FMT)
 
@@ -100,6 +102,15 @@ HANDSHAKE_VERSION = 1
 # device-fabric traffic counters (the /vars view of the "ICI NIC")
 g_tunnel_in_bytes = Adder()
 g_tunnel_out_bytes = Adder()
+# zero-copy receive accounting: payload bytes appended into the virtual
+# socket as BORROWED registered-block views (credit deferred to consumption)
+# vs bytes COPIED out of blocks (borrow cap hit, or no exporter support) —
+# the borrowed/copied split is the receive path's zero-copy proof
+g_tunnel_borrowed_bytes = Adder()
+g_tunnel_copied_bytes = Adder()
+# FT_ACK frames actually written vs credits they carried (batching ratio)
+g_tunnel_ack_frames = Adder()
+g_tunnel_ack_credits = Adder()
 
 
 # names created by THIS process (owner keeps resource_tracker registration)
@@ -137,19 +148,44 @@ def _maybe_untrack(name: str) -> None:
         pass
 
 
+# pools whose close was requested while borrowed views were still exported
+# (or whose shm close raced a view's dealloc cascade): retried when another
+# pool is created and at exit — the segment name is unlinked at exit either
+# way via _owned_pools
+_deferred_close_pools: List["BlockPool"] = []
+_deferred_close_lock = threading.Lock()
+
+
+def _sweep_deferred_pools() -> None:
+    with _deferred_close_lock:
+        pending = list(_deferred_close_pools)
+    for pool in pending:
+        pool._try_finish_close()
+
+
+_atexit.register(_sweep_deferred_pools)
+
+
 class BlockPool:
     """Our receive staging area — the registered memory region we advertise
     to the peer (reference rdma/block_pool.cpp). The PEER writes request/
-    response bytes into these blocks; we copy out and return credits."""
+    response bytes into these blocks; the receive path BORROWS views over
+    them into the virtual socket's read buffer and returns the credit only
+    when the parse path has consumed the bytes (export-tracked), falling
+    back to copy-and-ACK under window pressure."""
 
     def __init__(self, block_size: int = DEFAULT_BLOCK_SIZE,
                  block_count: int = DEFAULT_BLOCK_COUNT):
+        _sweep_deferred_pools()
         self.block_size = block_size
         self.block_count = block_count
         self.name = f"brpctpu_{os.getpid():x}_{secrets.token_hex(4)}"
         self._shm = _shm.SharedMemory(
             create=True, size=block_size * block_count, name=self.name)
         _owned_pools.add(self.name)
+        self._lock = threading.Lock()
+        self._exports = 0          # borrowed views currently alive
+        self._close_pending = False
         self._closed = False
 
     def view(self, idx: int, length: int) -> memoryview:
@@ -158,16 +194,66 @@ class BlockPool:
         off = idx * self.block_size
         return memoryview(self._shm.buf)[off:off + length]
 
+    # ------------------------------------------------------- borrow tracking
+    def add_export(self) -> None:
+        with self._lock:
+            self._exports += 1
+
+    def drop_export(self) -> None:
+        with self._lock:
+            self._exports -= 1
+            retry = self._close_pending and self._exports <= 0 \
+                and not self._closed
+        if retry:
+            self._try_finish_close()
+
+    @property
+    def exports(self) -> int:
+        with self._lock:
+            return self._exports
+
+    # ----------------------------------------------------------------- close
     def close(self) -> None:
-        if self._closed:
+        """Request close. With borrowed views still exported the unmap is
+        deferred to the last drop_export (an shm segment cannot unmap under
+        a live buffer export); the name is unlinked at exit regardless."""
+        with self._lock:
+            if self._closed or self._close_pending:
+                return
+            self._close_pending = True
+            busy = self._exports > 0
+        if busy:
+            with _deferred_close_lock:
+                _deferred_close_pools.append(self)
             return
-        self._closed = True
+        self._try_finish_close()
+
+    def _try_finish_close(self) -> None:
+        with self._lock:
+            if self._closed or self._exports > 0:
+                return
         try:
             self._shm.close()
+        except BufferError:
+            # a view's dealloc cascade is still holding the export (the
+            # release hook runs BEFORE the buffer ref is dropped): leave it
+            # on the deferred list — the next sweep/drop_export finishes
+            with _deferred_close_lock:
+                if self not in _deferred_close_pools:
+                    _deferred_close_pools.append(self)
+            return
+        except Exception:
+            pass
+        with self._lock:
+            self._closed = True
+        try:
             self._shm.unlink()
         except Exception:
             pass
         _owned_pools.discard(self.name)
+        with _deferred_close_lock:
+            if self in _deferred_close_pools:
+                _deferred_close_pools.remove(self)
 
 
 class PeerWindow:
@@ -329,7 +415,21 @@ class TpuEndpoint:
         self._send_lock = threading.Lock()
         self._failed = False
         self._fail_lock = threading.Lock()
+        # ---- deferred-credit accounting (zero-copy receive) ----
+        # RLock: a borrowed block's release hook can fire from a dealloc
+        # cascade triggered on a thread already inside the ack machinery
+        self._ack_lock = threading.RLock()
+        self._ack_pending: List[int] = []   # credits awaiting one FT_ACK
+        self._ack_hold = 0                  # >0: a cut batch is open, defer
+        self._borrowed_outstanding = 0      # blocks lent to the parse path
+        self._released_total = 0            # lifetime releases (diagnostics)
         self.vsock = TpuTransportSocket(self)
+        # coalesce credit returns across a dispatcher poll batch: the
+        # messenger brackets its cut loop with these hooks on both the
+        # bootstrap socket (outer TPUC frames) and the virtual socket
+        # (inner tunneled-protocol messages)
+        self.vsock.cut_batch_hook = self
+        ctrl_sock.cut_batch_hook = self
         if role == "server":
             self.vsock.owner_server = server
             from brpc_tpu.rpc.input_messenger import InputMessenger
@@ -526,48 +626,139 @@ class TpuEndpoint:
         return 0, False
 
     # -------------------------------------------------------------- recv path
-    def on_data(self, body: bytes) -> None:
+    def on_data(self, body: IOBuf) -> None:
         """Runs inline on the dispatcher parse loop — append stream bytes in
-        arrival order, ACK the consumed blocks, cut complete messages
-        (processing itself fans out to fiber workers in cut_messages)."""
-        inline_len, nsegs = struct.unpack_from(DATA_BODY_HDR, body)
-        if nsegs and self.recv_pool is None:
+        arrival order, cut complete messages (processing itself fans out to
+        fiber workers in cut_messages). ZERO-COPY: the frame body arrives as
+        an IOBuf cut from the bootstrap socket's read chain; inline payload
+        moves into the virtual socket's read_buf as refs, and block segments
+        are appended as BORROWED views over the registered pool — the ACK
+        credit is deferred until the parse path has actually consumed the
+        bytes (the borrowed view's release hook), batched across the poll
+        batch into one FT_ACK. Under window pressure (a message larger than
+        the borrow budget sits unparseable in read_buf) segments degrade to
+        copy-and-ACK so the peer's sender can never deadlock against our
+        parser (the eager-copy behavior this path replaced)."""
+        if self._failed:
+            return
+        if len(body) < DATA_BODY_HDR_SIZE:
+            self.fail(errors.EREQUEST, "short DATA frame")
+            return
+        inline_len, nsegs = struct.unpack(
+            DATA_BODY_HDR, body.fetch(DATA_BODY_HDR_SIZE))
+        body.pop_front(DATA_BODY_HDR_SIZE)
+        if len(body) < inline_len + nsegs * _SEG_SIZE:
+            self.fail(errors.EREQUEST, "truncated DATA frame")
+            return
+        pool = self.recv_pool
+        if nsegs and pool is None:
             # block refs before the HELLO created our pool: protocol abuse
             self.fail(errors.EREQUEST, "DATA before HELLO")
             return
         vsock = self.vsock
         got = 0
         if inline_len:
-            payload = body[8:8 + inline_len]
-            vsock.read_buf.append(payload)
-            got += len(payload)
+            # refs move from the bootstrap socket's chain; no payload copy
+            body.cutn_into(inline_len, vsock.read_buf)
+            got += inline_len
         if nsegs:
-            acks = []
-            off = 8
-            for _ in range(nsegs):
-                idx, ln = struct.unpack_from(SEG_FMT, body, off)
-                off += _SEG_SIZE
-                # copy out of the registered block before returning credit
-                vsock.read_buf.append(bytes(self.recv_pool.view(idx, ln)))
-                acks.append(idx)
+            seg_vals = struct.unpack(f"!{2 * nsegs}I",
+                                     body.fetch(nsegs * _SEG_SIZE))
+            # borrow budget: never lend more than half the window to the
+            # parse path — the other half keeps cycling via copy-and-ACK so
+            # a message bigger than the window still streams through
+            # (test_payload_larger_than_window_streams)
+            borrow_limit = max(1, pool.block_count // 2)
+            copied_acks: List[int] = []
+            for k in range(nsegs):
+                idx, ln = seg_vals[2 * k], seg_vals[2 * k + 1]
+                try:
+                    view = pool.view(idx, ln)
+                except ValueError:
+                    self.fail(errors.EREQUEST, "bad block ref in DATA")
+                    return
+                with self._ack_lock:
+                    borrow = self._borrowed_outstanding < borrow_limit
+                    if borrow:
+                        self._borrowed_outstanding += 1
+                if borrow:
+                    pool.add_export()
+                    if vsock.read_buf.append_user_data(
+                            view,
+                            release=functools.partial(self._credit_released,
+                                                      idx)):
+                        g_tunnel_borrowed_bytes.put(ln)
+                    else:
+                        # environment forced a copy; release already ran
+                        g_tunnel_copied_bytes.put(ln)
+                else:
+                    # window pressure: copy out and return credit eagerly
+                    vsock.read_buf.append(bytes(view))
+                    copied_acks.append(idx)
+                    g_tunnel_copied_bytes.put(ln)
                 got += ln
-            ack_body = struct.pack("!I", len(acks))
-            ack_body += b"".join(struct.pack("!I", i) for i in acks)
-            if self.ctrl.write(_pack_frame(FT_ACK, ack_body)) != 0:
-                # a lost ACK permanently leaks the peer's credits — the
-                # stream contract is broken, tear the tunnel down
-                self.fail(errors.EFAILEDSOCKET, "ACK write failed")
-                return
+            if copied_acks:
+                self._queue_acks(copied_acks)
         vsock.in_bytes += got
         vsock.last_active = _time.monotonic()
         g_tunnel_in_bytes.put(got)
         self._messenger.cut_messages(vsock)
 
+    # ------------------------------------------------- deferred batched acks
+    def _credit_released(self, idx: int) -> None:
+        """Release hook of one borrowed block: runs exactly once, whenever
+        the last view over the block dies (parser consumed the bytes, or
+        teardown dropped them)."""
+        pool = self.recv_pool
+        with self._ack_lock:
+            self._borrowed_outstanding -= 1
+            self._released_total += 1
+            dead = self._failed
+        if not dead:
+            self._queue_acks((idx,))
+        if pool is not None:
+            pool.drop_export()
+
+    def _queue_acks(self, indices) -> None:
+        with self._ack_lock:
+            self._ack_pending.extend(indices)
+            if self._ack_hold > 0 or self._failed:
+                return
+            acks = self._ack_pending
+            self._ack_pending = []
+        self._write_ack(acks)
+
+    def _write_ack(self, acks: List[int]) -> None:
+        if not acks:
+            return
+        body = struct.pack(f"!{len(acks) + 1}I", len(acks), *acks)
+        g_tunnel_ack_frames.put(1)
+        g_tunnel_ack_credits.put(len(acks))
+        if self.ctrl.write(_pack_frame(FT_ACK, body)) != 0:
+            # a lost ACK permanently leaks the peer's credits — the
+            # stream contract is broken, tear the tunnel down
+            self.fail(errors.EFAILEDSOCKET, "ACK write failed")
+
+    # messenger cut-batch bracket: while a poll batch is being cut, credit
+    # returns accumulate and flush as ONE FT_ACK at batch end
+    def cut_batch_begin(self) -> None:
+        with self._ack_lock:
+            self._ack_hold += 1
+
+    def cut_batch_end(self) -> None:
+        with self._ack_lock:
+            self._ack_hold -= 1
+            if self._ack_hold > 0 or self._failed or not self._ack_pending:
+                return
+            acks = self._ack_pending
+            self._ack_pending = []
+        self._write_ack(acks)
+
     def on_ack(self, body: bytes) -> None:
-        (n,) = struct.unpack_from("!I", body)
-        indices = struct.unpack_from(f"!{n}I", body, 4) if n else ()
-        if self.window is not None:
-            self.window.release(indices)
+        vals = struct.unpack(f"!{len(body) // 4}I", body[:len(body) & ~3])
+        n = vals[0] if vals else 0
+        if self.window is not None and n:
+            self.window.release(vals[1:1 + n])
 
     # ---------------------------------------------------------------- failure
     def fail(self, code: int, reason: str = "", from_vsock: bool = False) -> None:
@@ -576,8 +767,19 @@ class TpuEndpoint:
                 return
             self._failed = True
         self.ready.set()
+        # credits pending return die with the tunnel: the peer's window is
+        # being torn down too, and an ACK write would race the ctrl close
+        with self._ack_lock:
+            self._ack_pending.clear()
         if not from_vsock:
             self.vsock.set_failed(code, reason)
+        # drop un-parsed borrowed views NOW (outside any ack lock): their
+        # release hooks fire inside this clear() — each exactly once, with
+        # _failed already set so no ACK is queued — which usually leaves the
+        # pool export-free so the close below can unmap immediately. Views
+        # still held by in-flight message bodies release later; the pool
+        # defers its unmap until the last of those drops.
+        self.vsock.read_buf.clear()
         if self.window is not None:
             self.window.close()
         if self.recv_pool is not None:  # server may die pre-HELLO
@@ -622,13 +824,14 @@ class TpuCtrlProtocol(Protocol):
         if len(buf) < CTRL_HDR_SIZE + blen:
             return PARSE_NOT_ENOUGH_DATA, None
         buf.pop_front(CTRL_HDR_SIZE)
-        body = buf.cutn(blen).tobytes()
-        return 0, ParsedMessage(self, ftype, IOBuf(body))
+        # zero-copy crack: the body rides through as moved refs over the
+        # socket's read chain — on_data cuts the inline payload straight
+        # into the virtual socket and fetches only the tiny headers
+        return 0, ParsedMessage(self, ftype, buf.cutn(blen))
 
     def process(self, msg: ParsedMessage, server) -> None:
         sock = msg.socket
         ftype = msg.meta
-        body = msg.body.tobytes()
         ep: Optional[TpuEndpoint] = getattr(sock, "_tpu_endpoint", None)
         if ftype == FT_HELLO:
             if ep is None:
@@ -637,17 +840,17 @@ class TpuCtrlProtocol(Protocol):
                 sock.user_data = ep
                 if server is not None:
                     server._register_tpu_endpoint(ep)
-            ep.on_hello(body)
+            ep.on_hello(msg.body.tobytes())
             return
         if ep is None:
             sock.set_failed(errors.EREQUEST, "tpu ctrl frame before HELLO")
             return
         if ftype == FT_HELLO_ACK:
-            ep.on_hello_ack(body)
+            ep.on_hello_ack(msg.body.tobytes())
         elif ftype == FT_DATA:
-            ep.on_data(body)
+            ep.on_data(msg.body)   # IOBuf: payload bytes are never flattened
         elif ftype == FT_ACK:
-            ep.on_ack(body)
+            ep.on_ack(msg.body.tobytes())
         elif ftype == FT_BYE:
             ep.fail(errors.EFAILEDSOCKET, "peer sent BYE")
 

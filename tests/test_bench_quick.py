@@ -1,0 +1,66 @@
+"""CI smoke lane for bench.py (BENCH_QUICK + BENCH_PHASES=shm).
+
+Runs the benchmark's CPU-only shm-sweep phase end to end in a subprocess —
+real client/server process pair over the tpu:// tunnel — and asserts the
+contract the perf tooling depends on: a machine-readable headline JSON line
+on stdout, and the zero-copy receive counters (borrowed vs copied bytes,
+ACK batching ratio) on stderr.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bench_run():
+    env = dict(os.environ,
+               BENCH_QUICK="1",
+               BENCH_PHASES="shm",
+               BENCH_SKIP_DEVICE="1",
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                          capture_output=True, text=True, timeout=240,
+                          cwd=REPO, env=env)
+    assert proc.returncode == 0, \
+        f"bench.py failed rc={proc.returncode}:\n{proc.stderr[-2000:]}"
+    return proc
+
+
+def test_headline_json(bench_run):
+    lines = [l for l in bench_run.stdout.splitlines()
+             if l.startswith("{")]
+    assert len(lines) == 1, bench_run.stdout
+    headline = json.loads(lines[0])
+    assert headline["metric"] == "echo_1mb_framework_bandwidth"
+    assert headline["unit"] == "GB/s"
+    assert headline["value"] > 0, headline
+
+
+def test_only_shm_phase_ran(bench_run):
+    err = bench_run.stderr
+    assert "# tpu:// sweep" in err
+    # the skipped phases must not have produced their reports
+    assert "# multi_threaded_echo" not in err
+    assert "# hybrid lane" not in err
+    assert "# device lane" not in err
+
+
+def test_zero_copy_counters_emitted(bench_run):
+    err = bench_run.stderr
+    zc = [l for l in err.splitlines()
+          if l.startswith("# tpu:// zero-copy receive")]
+    assert zc, err
+    from brpc_tpu.butil.iobuf import supports_block_ownership
+
+    if not supports_block_ownership():
+        return  # degraded environment: counters exist but all-copied
+    assert "borrowed=" in zc[0] and "copied=" in zc[0], zc[0]
+    borrowed = int(zc[0].split("borrowed=")[1].split("B")[0].replace(",", ""))
+    assert borrowed > 0, zc[0]
+    assert any(l.startswith("# tpu:// ack batching") for l in err.splitlines())

@@ -114,6 +114,99 @@ class TestIOBuf:
         assert bytes(out) == b"abcdef"
 
 
+class TestIOBufBlockOwnership:
+    """append_user_data: a borrowed view's release callback fires exactly
+    once, when the LAST reference over the block dies — the mechanism the
+    tpu tunnel's zero-copy receive path hangs flow-control credits on."""
+
+    @staticmethod
+    def _borrowed(data=b"x" * 64):
+        from brpc_tpu.butil.iobuf import supports_block_ownership
+
+        if not supports_block_ownership():
+            pytest.skip("no block-ownership exporter in this environment")
+        backing = bytearray(data)
+        fired = []
+        buf = IOBuf()
+        assert buf.append_user_data(memoryview(backing),
+                                    release=lambda: fired.append(1)) is True
+        return buf, fired, backing
+
+    def test_release_fires_on_clear(self):
+        buf, fired, _ = self._borrowed()
+        assert fired == []
+        buf.clear()
+        assert len(fired) == 1
+
+    def test_release_fires_on_pop_front(self):
+        buf, fired, _ = self._borrowed()
+        buf.pop_front(10)
+        assert fired == []          # tail of the block is still referenced
+        buf.pop_front(len(buf))
+        assert len(fired) == 1
+
+    def test_fetch_does_not_release(self):
+        buf, fired, backing = self._borrowed()
+        assert buf.fetch(64) == bytes(backing)
+        assert fired == []
+        assert buf.tobytes() == bytes(backing)
+        assert fired == []
+        buf.clear()
+        assert len(fired) == 1
+
+    def test_cutn_splits_keep_block_alive(self):
+        buf, fired, backing = self._borrowed()
+        head = buf.cutn(20)
+        mid = buf.cutn(20)
+        assert fired == []
+        buf.clear()                 # tail gone
+        head.clear()
+        assert fired == []          # mid still holds a slice
+        assert mid.tobytes() == bytes(backing)[20:40]
+        mid.clear()
+        assert len(fired) == 1      # exactly once, at the LAST drop
+
+    def test_appended_bytes_are_readable_in_place(self):
+        buf, fired, backing = self._borrowed(b"hello borrowed world!")
+        other = IOBuf()
+        other.append(b"<")
+        buf.cutn_into(len(buf), other)
+        other.append(b">")
+        assert other.tobytes() == b"<hello borrowed world!>"
+        assert fired == []
+        other.clear()
+        assert len(fired) == 1
+
+    def test_empty_view_releases_immediately(self):
+        from brpc_tpu.butil.iobuf import supports_block_ownership
+
+        if not supports_block_ownership():
+            pytest.skip("no block-ownership exporter in this environment")
+        fired = []
+        buf = IOBuf()
+        assert buf.append_user_data(memoryview(b""),
+                                    release=lambda: fired.append(1)) is True
+        assert len(buf) == 0
+        assert len(fired) == 1
+
+    def test_no_release_plain_append(self):
+        buf = IOBuf()
+        assert buf.append_user_data(memoryview(b"plain")) is True
+        assert buf.tobytes() == b"plain"
+
+    def test_has_owned_blocks(self):
+        buf, fired, _ = self._borrowed()
+        assert buf.has_owned_blocks()
+        plain = IOBuf(b"abc")
+        assert not plain.has_owned_blocks()
+        # ownership travels with the refs through a cut
+        head = buf.cutn(32)
+        assert head.has_owned_blocks()
+        buf.clear()
+        head.clear()
+        assert not buf.has_owned_blocks()
+
+
 class TestEndPoint:
     def test_parse_ip(self):
         ep = EndPoint.parse("127.0.0.1:8787")

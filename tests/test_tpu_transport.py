@@ -197,6 +197,203 @@ class TestOrdinalAddressing:
             echo_pb2.EchoRequest(message="ok")).message == "ok"
 
 
+class _FakeCtrl:
+    """Stand-in bootstrap socket: records every frame the endpoint writes
+    so tests can assert exactly which credits were ACKed, and when."""
+
+    def __init__(self):
+        self.frames = []          # raw bytes, one entry per write()
+        self.failed = False
+        self.remote = None
+        self.error_code = 0
+        self.error_text = ""
+        self.on_failed_hook = None
+        self.cut_batch_hook = None
+
+    def write(self, data, id_wait=None):
+        if self.failed:
+            return 1
+        self.frames.append(
+            data.tobytes() if hasattr(data, "tobytes") else bytes(data))
+        return 0
+
+    def set_failed(self, code, reason=""):
+        self.failed = True
+        self.error_code = code
+        self.error_text = reason
+
+
+def _acked_indices(fake):
+    """All block indices returned so far, one list per FT_ACK frame."""
+    import struct
+
+    from brpc_tpu.tpu import transport as tr
+
+    out = []
+    for raw in fake.frames:
+        magic, ftype, blen = struct.unpack_from(tr.CTRL_HDR, raw)
+        if ftype == tr.FT_ACK:
+            body = raw[tr.CTRL_HDR_SIZE:tr.CTRL_HDR_SIZE + blen]
+            vals = struct.unpack(f"!{len(body) // 4}I", body)
+            out.append(list(vals[1:1 + vals[0]]))
+    return out
+
+
+def _make_endpoint():
+    from brpc_tpu.policy import ensure_registered
+    from brpc_tpu.tpu import transport as tr
+
+    ensure_registered()
+    fake = _FakeCtrl()
+    ep = tr.TpuEndpoint(fake, role="client", target_ordinal=0,
+                        block_size=64 * 1024, block_count=8)
+    return tr, fake, ep
+
+
+def _trpc_response_packet(payload: bytes) -> bytes:
+    """A complete, well-formed trpc_std RESPONSE for a correlation id that
+    does not exist — the client stack parses and then quietly drops it,
+    which is exactly the 'parser consumed the bytes' event."""
+    from brpc_tpu.policy.trpc_std import TrpcStdProtocol
+    from brpc_tpu.proto import rpc_meta_pb2
+
+    meta = rpc_meta_pb2.RpcMeta()
+    meta.correlation_id = 0x7FFF1234
+    meta.response.error_code = 0
+    return TrpcStdProtocol().pack_response(meta, payload).tobytes()
+
+
+def _data_frame_body(segs):
+    """DATA body referencing pool blocks: [(idx, ln), ...]."""
+    import struct
+
+    from brpc_tpu.tpu import transport as tr
+
+    body = struct.pack(tr.DATA_BODY_HDR, 0, len(segs))
+    for idx, ln in segs:
+        body += struct.pack(tr.SEG_FMT, idx, ln)
+    return body
+
+
+class TestCreditReturnExactlyOnce:
+    """Tentpole regression: a borrowed block's credit is released exactly
+    once, only after the parser consumed the bytes — and teardown with
+    borrows outstanding neither leaks credits nor double-releases."""
+
+    def test_credit_deferred_until_parse_consumes(self):
+        from brpc_tpu.butil.iobuf import IOBuf, supports_block_ownership
+
+        if not supports_block_ownership():
+            pytest.skip("no block-ownership exporter in this environment")
+        tr, fake, ep = _make_endpoint()
+        try:
+            pkt = _trpc_response_packet(b"\xcd" * 8192)
+            half = len(pkt) // 2
+            pool = ep.recv_pool
+            # peer 'writes' the packet across two registered blocks
+            pool._shm.buf[0:half] = pkt[:half]
+            blk = pool.block_size
+            pool._shm.buf[blk:blk + len(pkt) - half] = pkt[half:]
+
+            # frame 1: only the first half — the parser cannot finish, so
+            # NO credit may come back yet
+            ep.on_data(IOBuf(_data_frame_body([(0, half)])))
+            assert _acked_indices(fake) == []
+            assert ep._borrowed_outstanding == 1
+            assert ep._released_total == 0
+
+            # frame 2: the rest — the message parses, its body is consumed
+            # by the (unknown-cid) response path, credits flow back
+            ep.on_data(IOBuf(_data_frame_body([(1, len(pkt) - half)])))
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                acked = [i for frame in _acked_indices(fake) for i in frame]
+                if sorted(acked) == [0, 1]:
+                    break
+                time.sleep(0.01)
+            acked = [i for frame in _acked_indices(fake) for i in frame]
+            assert sorted(acked) == [0, 1], acked  # each EXACTLY once
+            deadline = time.monotonic() + 5
+            while ep._borrowed_outstanding and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert ep._borrowed_outstanding == 0
+            assert ep._released_total == 2
+        finally:
+            ep.fail(0, "test done")
+
+    def test_teardown_with_outstanding_borrow(self):
+        from brpc_tpu.butil.iobuf import IOBuf, supports_block_ownership
+
+        if not supports_block_ownership():
+            pytest.skip("no block-ownership exporter in this environment")
+        tr, fake, ep = _make_endpoint()
+        pkt = _trpc_response_packet(b"\xee" * 4096)
+        pool = ep.recv_pool
+        pool._shm.buf[0:64] = pkt[:64]   # incomplete head only
+        ep.on_data(IOBuf(_data_frame_body([(0, 64)])))
+        assert ep._borrowed_outstanding == 1
+        assert _acked_indices(fake) == []
+
+        frames_before = len(fake.frames)
+        ep.fail(999, "test teardown")
+        # the borrow was released exactly once by the teardown clear...
+        assert ep._released_total == 1
+        assert ep._borrowed_outstanding == 0
+        # ...but its credit was NOT acked (peer is gone), and no ack frame
+        # was written during/after teardown
+        assert _acked_indices(fake) == []
+        assert all(f[:4] == tr.CTRL_MAGIC[:4] for f in fake.frames)
+        # the pool unmapped inline: no exports were left behind
+        assert pool.exports == 0
+        assert pool._closed
+
+    def test_teardown_with_inflight_body_defers_pool_close(self):
+        from brpc_tpu.butil.iobuf import IOBuf, supports_block_ownership
+
+        if not supports_block_ownership():
+            pytest.skip("no block-ownership exporter in this environment")
+        tr, fake, ep = _make_endpoint()
+        pkt = _trpc_response_packet(b"\xaa" * 4096)
+        pool = ep.recv_pool
+        pool._shm.buf[0:64] = pkt[:64]
+        ep.on_data(IOBuf(_data_frame_body([(0, 64)])))
+        # simulate an in-flight message body still holding borrowed bytes
+        held = ep.vsock.read_buf.cutn(64)
+        ep.fail(999, "teardown with body in flight")
+        assert ep._released_total == 0          # the borrow is still live
+        assert pool.exports == 1
+        assert not pool._closed                 # unmap deferred, not forced
+        del held                                 # the fiber finishes
+        assert ep._released_total == 1           # exactly once
+        assert ep._borrowed_outstanding == 0
+        assert pool.exports == 0
+        tr._sweep_deferred_pools()               # retry outside the cascade
+        assert pool._closed
+        assert _acked_indices(fake) == []        # no credit ack after death
+
+    def test_loopback_echo_is_zero_copy(self, tpu_server):
+        """Acceptance: block-segment frames cross the receive path with
+        ZERO full-payload copies — all segment bytes are borrowed, none
+        copied (both directions of a loopback echo count here)."""
+        from brpc_tpu.butil.iobuf import supports_block_ownership
+        from brpc_tpu.tpu import transport as tr
+
+        if not supports_block_ownership():
+            pytest.skip("no block-ownership exporter in this environment")
+        stub = _stub_for(tpu_server)
+        payload = b"\x5a" * (1024 * 1024)
+        stub.Echo(echo_pb2.EchoRequest(message="warm", payload=payload))
+        borrowed0 = tr.g_tunnel_borrowed_bytes.get_value()
+        copied0 = tr.g_tunnel_copied_bytes.get_value()
+        r = stub.Echo(echo_pb2.EchoRequest(message="zc", payload=payload))
+        assert r.payload == payload
+        borrowed = tr.g_tunnel_borrowed_bytes.get_value() - borrowed0
+        copied = tr.g_tunnel_copied_bytes.get_value() - copied0
+        # request (server side) + response (client side) both ride blocks
+        assert borrowed >= 2 * len(payload), (borrowed, copied)
+        assert copied == 0, (borrowed, copied)
+
+
 class TestWindowAccounting:
     def test_credits_return_after_traffic(self, tpu_server):
         stub = _stub_for(tpu_server)
