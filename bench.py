@@ -21,9 +21,10 @@ Channel -> tpu:// transport -> Server stack, vs the reference's 2.3 GB/s
 loopback plateau (/root/reference/docs/cn/benchmark.md:104).
 
 Env knobs: BENCH_QUICK=1 shortens every phase (CI smoke); BENCH_SKIP_DEVICE=1
-skips the jax probe; BENCH_PHASES=shm,qps,native,hybrid,device runs only the
-named phases (default: all) — e.g. BENCH_PHASES=shm is the CPU-only tier-1
-smoke lane, whose headline is then the Python tpu:// sweep.
+skips the jax probe; BENCH_PHASES=shm,qps,native,hybrid,batch,device runs
+only the named phases (default: all) — e.g. BENCH_PHASES=shm is the CPU-only
+tier-1 smoke lane, whose headline is then the Python tpu:// sweep; batch is
+the adaptive-batching vs per-request dispatch comparison (also CPU-only).
 """
 
 from __future__ import annotations
@@ -191,16 +192,49 @@ def bench_tpu_sweep():
         print("# tpu:// sweep (shm block-pool transport, both-ways bytes; "
               "p50 at depth>1 includes closed-loop queueing):",
               file=sys.stderr)
+        # warm the largest size once: the first bulk call pays the block
+        # pool's page faults, which at 3 QUICK calls would dominate p50
+        _run_calls(stub, echo_pb2, b"\xab" * max(s for s, _, _ in SWEEP),
+                   1, 1)
+        by_size = {}
+        bulk_copied = bulk_borrowed = 0
         for size, threads, calls in SWEEP:
             payload = b"\xab" * size
+            b0 = (g_tunnel_borrowed_bytes.get_value(),
+                  g_tunnel_copied_bytes.get_value())
             wall, lats = _run_calls(stub, echo_pb2, payload, threads, calls)
             gbps = 2 * size * len(lats) / wall / 1e9
+            by_size[size] = gbps
+            if size == 16 << 20:
+                bulk_borrowed = g_tunnel_borrowed_bytes.get_value() - b0[0]
+                bulk_copied = g_tunnel_copied_bytes.get_value() - b0[1]
             print(f"#   {size:>9}B x{threads}thr x{calls}: "
                   f"{gbps:7.3f} GB/s  qps={len(lats)/wall:9,.0f}  "
                   f"p50={_percentile(lats,0.5)*1e3:7.2f}ms "
                   f"p99={_percentile(lats,0.99)*1e3:7.2f}ms", file=sys.stderr)
             if size == HEADLINE_SIZE:
                 headline = gbps
+        # regression guard for the 16MB entry (the ROADMAP "collapses to
+        # ~0.1 GB/s" item): bulk messages must stay inside the window's
+        # zero-copy borrow budget (DEFAULT_BLOCK_COUNT, tpu/transport.py).
+        # The budget overflowing shows up as copy-and-ACK fallback bytes —
+        # a deterministic signal, unlike the QUICK sweep's 3-call timings.
+        if (16 << 20) in by_size and HEADLINE_SIZE in by_size:
+            bulk_total = bulk_borrowed + bulk_copied
+            copied_frac = bulk_copied / bulk_total if bulk_total else 0.0
+            bulk_ratio = by_size[16 << 20] / max(by_size[HEADLINE_SIZE],
+                                                 1e-9)
+            print(f"# tpu:// sweep 16MB entry: {bulk_ratio:.2f}x the 1MB "
+                  f"rate, {copied_frac:.0%} of bulk bytes copied "
+                  f"(borrow-budget regression when > 10%)", file=sys.stderr)
+            from brpc_tpu.butil.iobuf import supports_block_ownership
+
+            if supports_block_ownership() and bulk_total \
+                    and copied_frac > 0.10:
+                raise RuntimeError(
+                    f"16MB sweep entry regressed: {copied_frac:.0%} of "
+                    f"bulk bytes fell back to copy-and-ACK — messages no "
+                    f"longer fit the tpu:// borrow budget")
         borrowed = g_tunnel_borrowed_bytes.get_value() - zc0[0]
         copied = g_tunnel_copied_bytes.get_value() - zc0[1]
         frames = g_tunnel_ack_frames.get_value() - zc0[2]
@@ -216,6 +250,93 @@ def bench_tpu_sweep():
                   f"{frames:,} FT_ACK frames "
                   f"({credits / frames:.1f} credits/frame)", file=sys.stderr)
         return headline
+    finally:
+        srv.close()
+
+
+def bench_batch_lane():
+    """Adaptive batching (brpc_tpu/batch/) head to head with per-request
+    dispatch: the same jitted MLP behind BatchBench.Infer (one B=1 jit call
+    per RPC) and BatchBench.InferBatched (concurrent RPCs coalesced into
+    one padded jit call). Pipelined async client, pure-Python server —
+    the win is per-item device-dispatch + interpreter cost amortized
+    across the batch. Returns the batched/per-request QPS ratio."""
+    import numpy as np
+
+    from brpc_tpu.policy.http_protocol import http_fetch
+    from brpc_tpu.proto import echo_pb2
+    from brpc_tpu.rpc import Channel, ChannelOptions
+    from brpc_tpu.rpc.channel import MethodDescriptor
+
+    srv = _BenchServer("127.0.0.1:0", "--batch")
+    try:
+        ch = Channel(ChannelOptions(protocol="trpc_std", timeout_ms=30000,
+                                    done_inline=True))
+        ch.init(srv.endpoint)
+        rng = np.random.default_rng(7)
+        req = echo_pb2.EchoRequest(
+            message="b",
+            payload=rng.standard_normal(256, dtype=np.float32).tobytes())
+
+        def run(method, depth, total):
+            md = MethodDescriptor("BatchBench", method,
+                                  echo_pb2.EchoRequest,
+                                  echo_pb2.EchoResponse)
+            done_ev = threading.Event()
+            state = {"issued": 0, "completed": 0, "errors": 0}
+            lats = []
+
+            def make_done(t0):
+                def done(cntl):
+                    lats.append(time.perf_counter() - t0)
+                    if cntl.failed():
+                        state["errors"] += 1
+                    state["completed"] += 1
+                    if state["issued"] < total:
+                        state["issued"] += 1
+                        ch.call_method(md, req,
+                                       done=make_done(time.perf_counter()))
+                    elif state["completed"] >= total:
+                        done_ev.set()
+                return done
+
+            t_start = time.perf_counter()
+            for _ in range(min(depth, total)):
+                state["issued"] += 1
+                ch.call_method(md, req, done=make_done(time.perf_counter()))
+            if not done_ev.wait(180):
+                raise RuntimeError(f"batch bench stalled ({method}): "
+                                   f"{state['completed']}/{total}")
+            if state["errors"]:
+                raise RuntimeError(
+                    f"{state['errors']} {method} calls failed")
+            wall = time.perf_counter() - t_start
+            lats.sort()
+            return len(lats) / wall, lats
+
+        run("Infer", 4, 30)          # warmup: connection + codepaths
+        run("InferBatched", 8, 60)
+        total_pr = 150 if QUICK else 600
+        total_b = 600 if QUICK else 4000
+        qps_pr, lat_pr = run("Infer", 16, total_pr)
+        qps_b, lat_b = run("InferBatched", 32, total_b)
+        ratio = qps_b / max(qps_pr, 1e-9)
+        print(f"# batch lane (jitted MLP 256x32L, pipelined py client): "
+              f"per-request qps={qps_pr:,.0f} "
+              f"p50={_percentile(lat_pr,0.5)*1e3:.2f}ms | batched "
+              f"qps={qps_b:,.0f} p50={_percentile(lat_b,0.5)*1e3:.2f}ms | "
+              f"batched/per-request = {ratio:.2f}x "
+              f"({'OK' if ratio >= 2.0 else 'BELOW'} 2x floor)",
+              file=sys.stderr)
+        # the observability half of the acceptance: the coalescing must be
+        # visible through /vars on the serving process
+        hostport = f"{_host_port(srv.endpoint)[0]}:" \
+                   f"{_host_port(srv.endpoint)[1]}"
+        for var in ("g_batch_size", "g_batch_queue_delay_us"):
+            body = http_fetch(hostport, "GET", f"/vars/{var}",
+                              timeout=10).body.decode().strip()
+            print(f"# batch lane /vars: {body}", file=sys.stderr)
+        return ratio
     finally:
         srv.close()
 
@@ -706,6 +827,8 @@ def main() -> None:
         native_1mb = max(native_1mb, tpu_1mb)
     if _phase_enabled("hybrid"):
         bench_hybrid_native()
+    if _phase_enabled("batch"):
+        bench_batch_lane()
     py_1mb = bench_tpu_sweep() if _phase_enabled("shm") else None
     if os.environ.get("BENCH_SKIP_DEVICE") != "1" and \
             _phase_enabled("device"):

@@ -35,6 +35,11 @@ _tls = threading.local()
 
 log = logging.getLogger("brpc_tpu.input_messenger")
 
+# Poll-batch boundary hook (brpc_tpu.batch installs flush_poll_batch here):
+# called after each cut loop so request batchers can flush everything the
+# last read batch admitted. None until a BatchQueue first registers.
+poll_batch_hook = None
+
 
 def _inline_cut_max() -> int:
     return int(flags.get("inline_cut_max_bytes"))
@@ -152,6 +157,9 @@ class InputMessenger:
         finally:
             if batch_hook is not None:
                 batch_hook.cut_batch_end()
+            hook = poll_batch_hook
+            if hook is not None:
+                hook()
         return count
 
     def _cut_batch_native(self, sock: Socket):

@@ -61,6 +61,12 @@ _RESP_HDR = 16
 _PACKED_HDR = struct.Struct("<iiQqQQ")    # kind,tag,conn,aux,mlen,blen
 _PACKED_PTRS = struct.Struct("<QQQ")      # base,meta,body for big events
 _PACKED_PTR_FLAG = 1 << 30
+
+# Poll-batch boundary hook (brpc_tpu.batch installs flush_poll_batch here):
+# the packed poll loop calls it after each event batch, mirroring
+# input_messenger's cut-loop call site, so requests parsed together (and
+# handled inline under usercode_inline) batch together.
+poll_batch_hook = None
 _name_cache: dict = {}   # raw svc+method bytes -> decoded (svc, meth)
 _flusher_tls = threading.local()  # threads that batch-flush queued sends
 
@@ -752,6 +758,9 @@ class NativeDataplane:
                     if base:
                         lib.dp_free(base)
             if nbytes:
+                hook = poll_batch_hook
+                if hook is not None:
+                    hook()  # batch queues flush at the event-batch boundary
                 lib.dp_flush_all(rt)  # queued inline responses go out now
             now = _time.monotonic()
             if now - last_sweep > 0.1:

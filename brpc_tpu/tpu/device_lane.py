@@ -181,6 +181,13 @@ class DeviceStore:
             return None
         return np.asarray(arr).tobytes()
 
+    def lookup(self, handle: int):
+        """The device-resident array behind a handle (no host copy) — how
+        batched methods (brpc_tpu.batch) gather HBM operands for one fused
+        call instead of fetching per item."""
+        with self._lock:
+            return self._arrays.get(handle)
+
     def free(self, handle: int) -> bool:
         with self._lock:
             arr = self._arrays.pop(handle, None)

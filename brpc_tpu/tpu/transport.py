@@ -80,7 +80,16 @@ SEG_FMT = "!II"               # block index, length
 _SEG_SIZE = struct.calcsize(SEG_FMT)
 
 DEFAULT_BLOCK_SIZE = 256 * 1024
-DEFAULT_BLOCK_COUNT = 64      # 16 MB window per direction
+# 80 MB window per direction. The window must be big enough that the
+# LARGEST bulk messages in flight fit inside the zero-copy borrow budget
+# (half the window, on_data's borrow_limit): at the old 16 MB window a
+# single 16 MB sweep message overflowed the 8 MB budget, the remainder
+# degraded to copy-and-ACK, and throughput collapsed to ~0.1 GB/s. The
+# 40 MB budget carries two concurrent 16 MB messages fully borrowed
+# (measured: copied bytes drop to zero on the 2-thread 16 MB sweep).
+# Backing pages are lazy (SharedMemory is an ftruncate), so idle
+# connections don't pay for the headroom.
+DEFAULT_BLOCK_COUNT = 320
 
 
 def clamp_geometry(bs: int, bc: int):

@@ -1,0 +1,98 @@
+"""Batched-inference echo server (brpc_tpu/batch/ — the execution_queue
+analog turned into continuous batching).
+
+    python examples/batched_inference/server.py [--port 8014]
+
+`Infer` is declared with @batched_method: concurrent RPCs coalesce into
+ONE jitted forward pass per flush (size, deadline, or poll-batch
+boundary, whichever first), padded to a declared bucket so the jit cache
+stays bounded. Requests reuse EchoRequest: ``payload`` carries DIM
+float32 features, the response message is the output row's checksum.
+
+Watch the coalescing live while the client runs:
+    curl localhost:8014/vars/g_batch_size
+    curl localhost:8014/vars/g_batch_queue_delay_us
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from brpc_tpu.batch import batched_method  # noqa: E402
+from brpc_tpu.proto import echo_pb2  # noqa: E402
+from brpc_tpu.rpc import Server, Service, errors  # noqa: E402
+
+DIM = 64
+
+
+class BatchedInferenceService(Service):
+    DESCRIPTOR = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+    def __init__(self):
+        import jax
+
+        self._W = np.asarray(
+            jax.random.normal(jax.random.PRNGKey(0), (DIM, DIM)))
+
+        @jax.jit
+        def fwd(x):  # (B, DIM) -> (B, DIM)
+            return jax.nn.relu(x @ self._W)
+
+        self._fwd = fwd
+        super().__init__()
+        # pre-warm the buckets so first-compile never lands on a request
+        for b in (1, 4, 16):
+            fwd(np.zeros((b, DIM), np.float32)).block_until_ready()
+
+    @batched_method(max_batch_size=16, max_delay_us=2000,
+                    bucket_shapes=(1, 4, 16))
+    def Echo(self, batch):
+        rows = []
+        for i, req in enumerate(batch.requests):
+            x = np.frombuffer(req.payload, np.float32)
+            if x.shape != (DIM,):
+                # one malformed request fails alone; its batchmates ride on
+                batch.fail(i, errors.EREQUEST,
+                           f"want {DIM} float32 features, got {x.size}")
+                x = np.zeros(DIM, np.float32)
+            rows.append(x)
+        y = self._fwd(batch.stack(rows))     # ONE call for the whole batch
+        sums = np.asarray(y.sum(axis=1))
+        return [echo_pb2.EchoResponse(
+                    message=f"batch={batch.size} sum={float(sums[i]):.4f}")
+                for i in range(batch.size)]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=8014)
+    ap.add_argument("--run_seconds", type=float, default=0,
+                    help="exit after N seconds (0 = forever)")
+    args = ap.parse_args(argv)
+
+    server = Server()
+    server.add_service(BatchedInferenceService())
+    server.start(f"0.0.0.0:{args.port}")
+    print(f"BatchedInference listening on {server.listen_endpoint()}",
+          flush=True)
+    try:
+        if args.run_seconds:
+            time.sleep(args.run_seconds)
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+    server.join()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,6 +2,7 @@
 in-process against loopback servers (reference pattern: tools are built on
 the public API only)."""
 
+import shutil
 import sys
 import threading
 import time
@@ -271,6 +272,9 @@ class TestTools:
         finally:
             server.stop(); server.join(timeout=2)
 
+    @pytest.mark.skipif(shutil.which("protoc") is None,
+                        reason="needs the protoc binary (the test compiles "
+                               "a user .proto at runtime)")
     def test_rpc_press_proto_json_io(self, tmp_path, capsys):
         """Reference rpc_press parity: runtime .proto compilation
         (--proto/--inc via protoc), JSON request input, JSON response

@@ -51,6 +51,53 @@ def test_only_shm_phase_ran(bench_run):
     assert "# device lane" not in err
 
 
+@pytest.fixture(scope="module")
+def batch_bench_run():
+    env = dict(os.environ,
+               BENCH_QUICK="1",
+               BENCH_PHASES="batch",
+               BENCH_SKIP_DEVICE="1",
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                          capture_output=True, text=True, timeout=300,
+                          cwd=REPO, env=env)
+    assert proc.returncode == 0, \
+        f"bench.py failed rc={proc.returncode}:\n{proc.stderr[-2000:]}"
+    return proc
+
+
+def test_batch_lane_report(batch_bench_run):
+    lanes = [l for l in batch_bench_run.stderr.splitlines()
+             if l.startswith("# batch lane (")]
+    assert len(lanes) == 1, batch_bench_run.stderr
+    line = lanes[0]
+    assert "per-request qps=" in line and "batched qps=" in line, line
+    ratio = float(line.split("batched/per-request = ")[1].split("x")[0])
+    # the acceptance floor: coalesced dispatch amortizes per-call jit
+    # dispatch + interpreter overhead across the batch
+    assert ratio >= 2.0, line
+    assert "OK 2x floor" in line, line
+
+
+def test_batch_lane_vars_counters(batch_bench_run):
+    err = batch_bench_run.stderr
+    for var in ("g_batch_size", "g_batch_queue_delay_us"):
+        lines = [l for l in err.splitlines()
+                 if l.startswith(f"# batch lane /vars: {var}")]
+        assert lines, f"missing {var} in:\n{err[-2000:]}"
+        # a live average: "name : avg (count=N)" with N > 0
+        assert "(count=" in lines[0], lines[0]
+        count = int(lines[0].split("(count=")[1].split(")")[0])
+        assert count > 0, lines[0]
+
+
+def test_batch_phase_skips_others(batch_bench_run):
+    err = batch_bench_run.stderr
+    assert "# tpu:// sweep" not in err
+    assert "# multi_threaded_echo" not in err
+    assert "# device lane" not in err
+
+
 def test_zero_copy_counters_emitted(bench_run):
     err = bench_run.stderr
     zc = [l for l in err.splitlines()

@@ -20,6 +20,90 @@ from brpc_tpu.proto import echo_pb2  # noqa: E402
 from brpc_tpu.rpc import Server, ServerOptions, Service  # noqa: E402
 
 
+class BatchBenchService(Service):
+    """--batch mode: the same jitted MLP served two ways, so bench.py can
+    compare dispatch disciplines head to head on one process.
+
+      Infer         — per-request: one jit call per RPC (B=1)
+      InferBatched  — adaptive batching (brpc_tpu.batch): concurrent RPCs
+                      coalesce into one padded jit call per bucket
+
+    Requests reuse EchoRequest (no protoc in the container): ``payload``
+    carries DIM float32 features; the response message is the output row's
+    checksum so the client can verify real compute happened per item."""
+
+    service_name = "BatchBench"
+    DIM = 256
+    LAYERS = 32
+    BUCKETS = (1, 8, 32)
+
+    def __init__(self):
+        super().__init__()
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        from brpc_tpu.batch import make_batched
+
+        k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+        scale = 1.0 / np.sqrt(self.DIM)
+        W = jax.random.normal(k1, (self.LAYERS, self.DIM, self.DIM),
+                              jnp.float32) * scale
+        b = jax.random.normal(k2, (self.LAYERS, self.DIM), jnp.float32) * .01
+
+        @jax.jit
+        def fwd(x):  # (B, DIM) -> (B, DIM)
+            def layer(h, wb):
+                return jax.nn.relu(h @ wb[0] + wb[1]), None
+            h, _ = jax.lax.scan(layer, x, (W, b))
+            return h
+
+        self._np = np
+        self._fwd = fwd
+        self.add_method("Infer", self.Infer,
+                        echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+        self.add_method(
+            "InferBatched",
+            make_batched("BatchBench.InferBatched", self.InferBatched,
+                         max_batch_size=self.BUCKETS[-1], max_delay_us=2000,
+                         bucket_shapes=self.BUCKETS,
+                         # steady pipelined load: let size/deadline shape
+                         # the batches; boundary flushes would fragment
+                         # them (each readable event admits only a few)
+                         flush_on_poll_batch=False),
+            echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+        # pre-warm every bucket so first-compile never lands on a request
+        for bb in self.BUCKETS:
+            fwd(np.zeros((bb, self.DIM), np.float32)).block_until_ready()
+
+    def _row(self, request):
+        x = self._np.frombuffer(request.payload, self._np.float32)
+        if x.shape != (self.DIM,):
+            raise ValueError(f"want {self.DIM} float32 features, "
+                             f"got {x.size}")
+        return x
+
+    def Infer(self, cntl, request, done):
+        y = self._fwd(self._row(request)[None])
+        return echo_pb2.EchoResponse(message=f"{float(y[0].sum()):.4f}")
+
+    def InferBatched(self, batch):
+        from brpc_tpu.rpc import errors
+
+        rows = []
+        for i, r in enumerate(batch.requests):
+            try:
+                rows.append(self._row(r))
+            except Exception as e:
+                batch.fail(i, errors.EREQUEST, str(e))
+                rows.append(self._np.zeros(self.DIM, self._np.float32))
+        x = batch.stack(rows)
+        y = self._fwd(x)                     # ONE call for the whole batch
+        sums = self._np.asarray(y.sum(axis=1))
+        return [echo_pb2.EchoResponse(message=f"{float(sums[i]):.4f}")
+                for i in range(batch.size)]
+
+
 class EchoServiceImpl(Service):
     DESCRIPTOR = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
 
@@ -52,6 +136,10 @@ def main(argv=None):
     ap.add_argument("--device", action="store_true",
                     help="serve DeviceDataService (this process owns the "
                          "chip; payloads live in HBM, tpu/device_lane.py)")
+    ap.add_argument("--batch", action="store_true",
+                    help="serve BatchBench (same jitted MLP as Infer "
+                         "per-request vs InferBatched through the "
+                         "adaptive batcher, brpc_tpu/batch/)")
     ap.add_argument("--null", action="store_true",
                     help="answer Echo as the null-service CONTROL: raw "
                          "body echo from the poll loop, no policy "
@@ -75,6 +163,8 @@ def main(argv=None):
         # resident so the bench can stream it repeatedly
         stream_impl = DeviceStreamEchoService(dds.store, rounds=1024,
                                               free_after=False)
+    if args.batch:
+        server.add_service(BatchBenchService())
     server.add_service(EchoServiceImpl(device_stream_impl=stream_impl))
     server.start(args.listen)
     if args.native_echo:
