@@ -249,6 +249,25 @@ def bench_tpu_sweep():
             print(f"# tpu:// ack batching: {credits:,} credits in "
                   f"{frames:,} FT_ACK frames "
                   f"({credits / frames:.1f} credits/frame)", file=sys.stderr)
+        # streaming-parse guard: the window shrank 320 -> 64 blocks on the
+        # strength of mid-message credit return keeping the in-flight
+        # borrow footprint at a frame's worth, not a message's worth. Peak
+        # borrowed-outstanding at (or past) the window means claiming
+        # stopped happening mid-body and the shrunken window is now the
+        # bottleneck again.
+        from brpc_tpu.butil.iobuf import supports_block_ownership
+        from brpc_tpu.tpu.transport import (DEFAULT_BLOCK_COUNT,
+                                            borrowed_peak_blocks)
+
+        peak = borrowed_peak_blocks()
+        print(f"# tpu:// borrowed peak: {peak} blocks "
+              f"(window {DEFAULT_BLOCK_COUNT})", file=sys.stderr)
+        if supports_block_ownership() and total \
+                and peak >= DEFAULT_BLOCK_COUNT:
+            raise RuntimeError(
+                f"peak borrowed-outstanding ({peak} blocks) reached the "
+                f"{DEFAULT_BLOCK_COUNT}-block window — bodies are no "
+                f"longer being claimed mid-message")
         return headline
     finally:
         srv.close()

@@ -8,6 +8,9 @@ smoke assert on.
 
 from __future__ import annotations
 
+import threading
+from typing import Dict
+
 from brpc_tpu.metrics import Adder, IntRecorder, Variable
 
 
@@ -61,3 +64,36 @@ def note_flush(reason: str, size: int) -> None:
 
 def note_queue_delay(delay_us: float) -> None:
     queue_delay_recorder.record(delay_us)
+
+
+# ---------------------------------------------------------------------------
+# per-bucket pad waste: a flush padded to jit-bucket B with S live items
+# wasted B-S padded rows of compute. One recorder per bucket size, exposed
+# lazily as g_batch_pad_waste_<bucket> ("avg wasted rows (count=flushes)"),
+# so /vars shows exactly which bucket boundaries burn padding — the signal
+# for retuning BatchPolicy.buckets.
+_pad_waste_lock = threading.Lock()
+_pad_waste_recorders: Dict[int, IntRecorder] = {}
+_pad_waste_vars: Dict[int, AvgVariable] = {}  # keep exposed vars alive
+
+
+def note_pad_waste(bucket: int, size: int) -> None:
+    waste = bucket - size
+    if waste < 0:  # unbucketed policy (bucket_for returned size)
+        return
+    rec = _pad_waste_recorders.get(bucket)
+    if rec is None:
+        with _pad_waste_lock:
+            rec = _pad_waste_recorders.get(bucket)
+            if rec is None:
+                rec = IntRecorder()
+                _pad_waste_vars[bucket] = AvgVariable(rec).expose(
+                    f"g_batch_pad_waste_{bucket}")
+                _pad_waste_recorders[bucket] = rec
+    rec.record(waste)
+
+
+def pad_waste_buckets() -> Dict[int, IntRecorder]:
+    """Snapshot of the per-bucket recorders (tests, dashboards)."""
+    with _pad_waste_lock:
+        return dict(_pad_waste_recorders)

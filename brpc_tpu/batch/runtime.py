@@ -129,6 +129,7 @@ def run_batch(queue: BatchQueue, items: List[BatchItem], reason: str) -> None:
     """Runner installed on every BatchQueue: build the context, invoke the
     vectorized handler once, scatter per-item responses/errors."""
     bucket = queue.policy.bucket_for(len(items))
+    bmetrics.note_pad_waste(bucket, len(items))
     ctx = BatchContext(items, bucket, reason)
     now_us = time.monotonic_ns() // 1000
     note = (f"batch: size={ctx.size} bucket={bucket} reason={reason} "

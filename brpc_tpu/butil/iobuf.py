@@ -179,6 +179,41 @@ class IOBuf:
         self._size -= n
         return n
 
+    def cutn_into_buffer(self, n: int, dest) -> int:
+        """Copy the first n bytes into writable buffer ``dest`` and pop them.
+
+        The claiming consume of the streaming parse path: unlike ``cutn``
+        (which moves refs and keeps source blocks alive inside the result),
+        this drops the source refs as it copies, so release hooks of borrowed
+        registered blocks fire immediately — mid-message, not when the parsed
+        message is eventually dropped. Returns bytes copied.
+        """
+        if n < 0:
+            raise ValueError(f"cutn_into_buffer({n})")
+        n = min(n, self._size)
+        if n == 0:
+            return 0
+        target = dest if isinstance(dest, memoryview) else memoryview(dest)
+        if target.format != "B":
+            target = target.cast("B")
+        remain = n
+        off = 0
+        refs = self._refs
+        while remain > 0:
+            mv = refs[0]
+            ln = mv.nbytes
+            if ln <= remain:
+                target[off:off + ln] = mv
+                off += ln
+                remain -= ln
+                refs.popleft()
+            else:
+                target[off:off + remain] = mv[:remain]
+                refs[0] = mv[remain:]
+                remain = 0
+        self._size -= n
+        return n
+
     def pop_front(self, n: int) -> int:
         """Drop the first n bytes."""
         if n < 0:

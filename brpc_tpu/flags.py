@@ -177,3 +177,9 @@ inline_cut_max_bytes = define(
     "read bursts beyond this are parsed on a fiber worker instead of the "
     "event loop (reference ProcessEvent handoff, socket.cpp:2256)",
     validator=_positive)
+stream_body_min_bytes = define(
+    "stream_body_min_bytes", 256 * 1024,
+    "message bodies at least this large are consumed incrementally through "
+    "a pending-body cursor once their header is cracked, so transport "
+    "flow-control credits return mid-message", reloadable=True,
+    validator=_positive)

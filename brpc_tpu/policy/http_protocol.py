@@ -36,7 +36,10 @@ from brpc_tpu.rpc.protocol import (
     PARSE_NOT_ENOUGH_DATA,
     PARSE_TRY_OTHERS,
     ParsedMessage,
+    PendingBodyCursor,
     Protocol,
+    can_stream_body,
+    stream_body_min,
 )
 
 MAX_HEADER = 64 * 1024
@@ -161,7 +164,12 @@ def _decode_chunked(data: bytes) -> Optional[Tuple[bytes, int]]:
         pos = chunk_end + 2
 
 
-def parse_http_message(buf: IOBuf) -> Tuple[int, Optional[HttpMessage]]:
+def parse_http_message(buf: IOBuf, sock=None,
+                       proto=None) -> Tuple[int, Optional[HttpMessage]]:
+    """Cut one HTTP/1.1 message. With ``sock`` + ``proto`` (the cut-loop
+    entry), a large incomplete content-length body registers a streaming
+    pending-body cursor instead of waiting for full buffering; standalone
+    callers (http_fetch) omit both and keep whole-message semantics."""
     head = buf.fetch(min(len(buf), MAX_HEADER))
     if not head:
         return PARSE_NOT_ENOUGH_DATA, None
@@ -214,6 +222,20 @@ def parse_http_message(buf: IOBuf) -> Tuple[int, Optional[HttpMessage]]:
     if clen < 0:
         return PARSE_BAD, None
     if len(buf) < body_start + clen:
+        if (proto is not None and clen >= stream_body_min()
+                and can_stream_body(sock)):
+            # headers are parsed and the declared body is large: stream the
+            # remainder through a cursor so arriving bytes are consumed
+            # (and any transport credits returned) before the body completes
+            buf.pop_front(body_start)
+
+            def _finish(cur, msg=msg, proto=proto):
+                msg.body = bytes(cur.claimed())
+                return ParsedMessage(proto, msg, IOBuf(msg.body))
+
+            cursor = PendingBodyCursor(proto, clen, finish=_finish)
+            cursor.feed(buf)
+            sock.pending_body = cursor
         return PARSE_NOT_ENOUGH_DATA, None
     buf.pop_front(body_start)
     msg.body = buf.cutn(clen).tobytes() if clen else b""
@@ -259,10 +281,11 @@ def render_request(method: str, path: str, host: str, body: bytes = b"",
 
 class HttpProtocol(Protocol):
     name = "http"
+    stateful = True  # parse(buf, sock): streams large content-length bodies
 
     # ------------------------------------------------------------------ wire
-    def parse(self, buf: IOBuf):
-        rc, msg = parse_http_message(buf)
+    def parse(self, buf: IOBuf, sock=None):
+        rc, msg = parse_http_message(buf, sock=sock, proto=self)
         if rc != 0:
             return rc, None
         return 0, ParsedMessage(self, msg, IOBuf(msg.body))

@@ -26,7 +26,10 @@ from brpc_tpu.rpc.protocol import (
     PARSE_NOT_ENOUGH_DATA,
     PARSE_TRY_OTHERS,
     ParsedMessage,
+    PendingBodyCursor,
     Protocol,
+    can_stream_body,
+    stream_body_min,
 )
 
 MAGIC = b"TRPC"
@@ -45,9 +48,11 @@ def max_body_size() -> int:
 class TrpcStdProtocol(Protocol):
     name = "trpc_std"
     magic = MAGIC
+    stateful = True  # parse(buf, sock): registers streaming body cursors
 
     # ------------------------------------------------------------------ wire
-    def parse(self, buf: IOBuf) -> Tuple[int, Optional[ParsedMessage]]:
+    def parse(self, buf: IOBuf,
+              sock=None) -> Tuple[int, Optional[ParsedMessage]]:
         if len(buf) < HEADER_SIZE:
             # can we at least rule the protocol out?
             head = buf.fetch(min(len(buf), 4))
@@ -62,6 +67,25 @@ class TrpcStdProtocol(Protocol):
             return PARSE_BAD, None
         total = HEADER_SIZE + meta_size + body_size
         if len(buf) < total:
+            if (body_size >= stream_body_min()
+                    and len(buf) >= HEADER_SIZE + meta_size
+                    and can_stream_body(sock)):
+                # header + meta are in hand and the body is large: consume
+                # what has arrived NOW and register a cursor for the rest,
+                # so the transport's flow-control credits return mid-message
+                # instead of after the whole body buffers up
+                buf.pop_front(HEADER_SIZE)
+                meta_bytes = buf.cutn(meta_size).tobytes()
+                try:
+                    meta = rpc_meta_pb2.RpcMeta.FromString(meta_bytes)
+                except Exception:
+                    return PARSE_BAD, None
+                cursor = PendingBodyCursor(
+                    self, body_size,
+                    finish=lambda cur, meta=meta: ParsedMessage(
+                        self, meta, cur.body()))
+                cursor.feed(buf)
+                sock.pending_body = cursor
             return PARSE_NOT_ENOUGH_DATA, None
         buf.pop_front(HEADER_SIZE)
         meta_bytes = buf.cutn(meta_size).tobytes()

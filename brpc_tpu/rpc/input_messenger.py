@@ -131,15 +131,36 @@ class InputMessenger:
         if batch_hook is not None:
             batch_hook.cut_batch_begin()
         try:
-            while len(sock.read_buf):
-                batch = self._cut_batch_native(sock)
-                if batch:
-                    msgs = batch
-                else:
-                    msg = self._cut_one(sock)
+            while True:
+                # streaming parse: a protocol that cracked a header but saw
+                # an incomplete body registered a pending-body cursor; feed
+                # it FIRST, byte-for-byte from read_buf, without re-running
+                # parse — each feed consumes the arriving refs, so borrowed
+                # blocks release (and their credits return) mid-message
+                cursor = getattr(sock, "pending_body", None)
+                if cursor is not None:
+                    if len(sock.read_buf):
+                        cursor.feed(sock.read_buf)
+                    if not cursor.done:
+                        break  # mid-body: wait for the next read burst
+                    sock.pending_body = None
+                    msg = cursor.finish()
                     if msg is None:
-                        break
+                        continue  # protocol consumed the body internally
                     msgs = (msg,)
+                elif not len(sock.read_buf):
+                    break
+                else:
+                    batch = self._cut_batch_native(sock)
+                    if batch:
+                        msgs = batch
+                    else:
+                        msg = self._cut_one(sock)
+                        if msg is None:
+                            if getattr(sock, "pending_body", None) is not None:
+                                continue  # parse just registered a cursor
+                            break
+                        msgs = (msg,)
                 for msg in msgs:
                     msg.socket = sock
                     sock.in_messages += 1
@@ -170,6 +191,11 @@ class InputMessenger:
         ParsedMessages, or None to fall back to the generic path."""
         proto = sock.preferred_protocol
         if proto is None or proto.magic not in (b"TRPC", b"TSTR"):
+            return None
+        if getattr(sock, "pending_body", None) is not None:
+            # mid-body bytes belong to the cursor, never to a fresh scan
+            # (the cut loop feeds the cursor before reaching here; this
+            # guards any other caller)
             return None
         scanner = _thread_scanner()
         if scanner is None:
@@ -236,6 +262,10 @@ class InputMessenger:
             else:
                 rc, msg = proto.parse(sock.read_buf)
             if rc == PARSE_NOT_ENOUGH_DATA:
+                if getattr(sock, "pending_body", None) is not None:
+                    # the parse cracked a header and registered a streaming
+                    # cursor — this protocol owns the connection from here
+                    sock.preferred_protocol = proto
                 return None
             if rc == PARSE_TRY_OTHERS:
                 continue

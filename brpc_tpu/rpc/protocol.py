@@ -22,6 +22,106 @@ PARSE_TRY_OTHERS = 2
 PARSE_BAD = 3
 
 
+def stream_body_min() -> int:
+    """Bodies at least this large stream through a PendingBodyCursor."""
+    from brpc_tpu import flags
+
+    return int(flags.get("stream_body_min_bytes"))
+
+
+def can_stream_body(sock) -> bool:
+    """True when ``sock`` accepts a pending-body cursor right now.
+
+    Only sockets that declare a ``pending_body`` slot participate (Socket and
+    the tunnel's virtual socket); plain IOBuf fuzzing harnesses and foreign
+    objects fall back to whole-message buffering. A slot already holding a
+    cursor also refuses — one in-flight body per connection, matching the
+    serial cut loop.
+    """
+    return sock is not None and getattr(sock, "pending_body", False) is None
+
+
+class PendingBodyCursor:
+    """Mid-message consumption state for one declared-length body.
+
+    A protocol that has cracked a message header but whose body has not fully
+    arrived may pop the header, register a cursor on the socket
+    (``sock.pending_body = cursor``) and return PARSE_NOT_ENOUGH_DATA. From
+    then on ``InputMessenger.cut_messages`` feeds arriving bytes straight from
+    ``read_buf`` into the cursor without re-running ``parse``; when the last
+    byte lands the cut loop calls ``finish()`` and dispatches the returned
+    ParsedMessage through the normal per-message path.
+
+    Why this exists: transports that defer flow-control credits to actual
+    consumption (the tpu tunnel's borrowed registered blocks) otherwise hold
+    every block of a large message hostage until the *whole* message parses.
+    With a cursor, each arriving chunk is consumed on arrival, so block
+    release hooks — and therefore FT_ACK credits — fire mid-message and the
+    negotiated window can stay small.
+
+    Two consumption modes:
+
+    * ``claim=True`` (default): bytes are copied into a preallocated
+      contiguous buffer and the source refs dropped immediately — the copy IS
+      the consumption signal. Not an extra copy in practice: protocols
+      materialize the body contiguously at deserialize time anyway
+      (``tobytes``); claiming merely moves that copy to arrival time, where
+      it buys credit return.
+    * ``claim=False``: refs move zero-copy (``cutn_into``) into an internal
+      IOBuf; consumption signals fire only when the finished message drops
+      them. For framing layers whose bodies carry no deferred credits (TPUC
+      inline frames).
+    """
+
+    __slots__ = ("protocol", "total", "remaining", "_view", "_out", "_finish")
+
+    def __init__(self, protocol: "Protocol", total: int, finish,
+                 claim: bool = True):
+        self.protocol = protocol
+        self.total = total
+        self.remaining = total
+        self._finish = finish
+        if claim:
+            self._view = memoryview(bytearray(total))
+            self._out = None
+        else:
+            self._view = None
+            self._out = IOBuf()
+
+    def feed(self, buf: IOBuf) -> int:
+        """Consume up to ``remaining`` bytes from buf; returns bytes taken."""
+        n = min(self.remaining, len(buf))
+        if n <= 0:
+            return 0
+        if self._out is not None:
+            buf.cutn_into(n, self._out)
+        else:
+            off = self.total - self.remaining
+            buf.cutn_into_buffer(n, self._view[off:off + n])
+        self.remaining -= n
+        return n
+
+    @property
+    def done(self) -> bool:
+        return self.remaining == 0
+
+    def body(self) -> IOBuf:
+        """The completed body as an IOBuf (zero-copy over the claim buffer)."""
+        if self._out is not None:
+            return self._out
+        out = IOBuf()
+        out.append(self._view)
+        return out
+
+    def claimed(self) -> memoryview:
+        """The claim-mode destination buffer (claim=True cursors only)."""
+        return self._view
+
+    def finish(self) -> Optional["ParsedMessage"]:
+        """Build the completed message; called once by the cut loop."""
+        return self._finish(self)
+
+
 class ParsedMessage:
     """One complete wire message, protocol-tagged."""
 

@@ -52,6 +52,9 @@ class Socket:
         self.dispatcher = dispatcher
         self.read_buf = IOBuf()
         self.preferred_protocol = None
+        # streaming parse: the one in-flight PendingBodyCursor (protocol.py)
+        # this connection's cut loop is feeding, or None
+        self.pending_body = None
         self.failed = False
         self._eof = False   # clean FIN seen; fail after buffered bytes parse
         self.error_code = 0
@@ -329,6 +332,9 @@ class Socket:
             self.failed = True
             self.error_code = code
             self.error_text = reason
+            # a half-fed body never completes; drop it (and any borrowed
+            # block refs it claimed) with the connection
+            self.pending_body = None
         try:
             self.dispatcher.remove_consumer(self.fd)
         except Exception:

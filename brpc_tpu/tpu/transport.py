@@ -80,16 +80,17 @@ SEG_FMT = "!II"               # block index, length
 _SEG_SIZE = struct.calcsize(SEG_FMT)
 
 DEFAULT_BLOCK_SIZE = 256 * 1024
-# 80 MB window per direction. The window must be big enough that the
-# LARGEST bulk messages in flight fit inside the zero-copy borrow budget
-# (half the window, on_data's borrow_limit): at the old 16 MB window a
-# single 16 MB sweep message overflowed the 8 MB budget, the remainder
-# degraded to copy-and-ACK, and throughput collapsed to ~0.1 GB/s. The
-# 40 MB budget carries two concurrent 16 MB messages fully borrowed
-# (measured: copied bytes drop to zero on the 2-thread 16 MB sweep).
-# Backing pages are lazy (SharedMemory is an ftruncate), so idle
-# connections don't pay for the headroom.
-DEFAULT_BLOCK_COUNT = 320
+# 16 MB window per direction. The window no longer has to hold a whole
+# bulk message: once a protocol cracks a header it registers a streaming
+# pending-body cursor, so borrowed blocks are consumed — and their FT_ACK
+# credits returned — mid-message, a few blocks after they arrive. A 16 MB
+# sweep message therefore cycles through the 8 MB borrow budget (half the
+# window) instead of overflowing it; the 320-block (80 MB) window the
+# pre-streaming code needed to avoid copy-and-ACK collapse is pinned shm
+# we no longer pay for. bench_tpu_sweep asserts both halves of this:
+# 16 MB entries stay ≤10% copied AND peak borrowed-outstanding stays
+# under this window.
+DEFAULT_BLOCK_COUNT = 64
 
 
 def clamp_geometry(bs: int, bc: int):
@@ -105,21 +106,51 @@ def clamp_geometry(bs: int, bc: int):
         bc //= 2
     return bs, bc
 INLINE_MAX = 16 * 1024        # small messages skip the block pool entirely
-MAX_SEGS_PER_FRAME = 32
+MAX_SEGS_PER_FRAME = 32       # wire-format cap on segments per DATA frame
+# send pipelining quantum: acquire/fill/post this many blocks (1 MB) per
+# frame so the ctrl write of frame k overlaps the memcpy into frame k+1's
+# blocks, and a large message never parks waiting for more credits than
+# one frame needs (the old loop demanded up to MAX_SEGS_PER_FRAME at once)
+SEND_PIPELINE_SEGS = 4
 HANDSHAKE_VERSION = 1
 
-# device-fabric traffic counters (the /vars view of the "ICI NIC")
-g_tunnel_in_bytes = Adder()
-g_tunnel_out_bytes = Adder()
+# device-fabric traffic counters (the /vars view of the "ICI NIC");
+# named Adders self-expose, so /vars and the Prometheus exporter see them
+g_tunnel_in_bytes = Adder("g_tunnel_in_bytes")
+g_tunnel_out_bytes = Adder("g_tunnel_out_bytes")
 # zero-copy receive accounting: payload bytes appended into the virtual
 # socket as BORROWED registered-block views (credit deferred to consumption)
 # vs bytes COPIED out of blocks (borrow cap hit, or no exporter support) —
 # the borrowed/copied split is the receive path's zero-copy proof
-g_tunnel_borrowed_bytes = Adder()
-g_tunnel_copied_bytes = Adder()
+g_tunnel_borrowed_bytes = Adder("g_tunnel_borrowed_bytes")
+g_tunnel_copied_bytes = Adder("g_tunnel_copied_bytes")
 # FT_ACK frames actually written vs credits they carried (batching ratio)
-g_tunnel_ack_frames = Adder()
-g_tunnel_ack_credits = Adder()
+g_tunnel_ack_frames = Adder("g_tunnel_ack_frames")
+g_tunnel_ack_credits = Adder("g_tunnel_ack_credits")
+
+# high-water mark of blocks lent to the parse path at once (any endpoint in
+# this process): with streaming consume this must sit well below the window
+# even while a multi-window message is in flight — bench_tpu_sweep asserts it
+_borrow_peak_lock = threading.Lock()
+_borrow_peak_blocks = 0
+
+
+def _note_borrow_peak(outstanding: int) -> None:
+    global _borrow_peak_blocks
+    if outstanding > _borrow_peak_blocks:
+        with _borrow_peak_lock:
+            if outstanding > _borrow_peak_blocks:
+                _borrow_peak_blocks = outstanding
+
+
+def borrowed_peak_blocks() -> int:
+    return _borrow_peak_blocks
+
+
+from brpc_tpu.metrics.status import PassiveStatus as _PassiveStatus  # noqa: E402
+
+g_tunnel_borrowed_peak_blocks = _PassiveStatus(
+    borrowed_peak_blocks).expose("g_tunnel_borrowed_peak_blocks")
 
 
 # names created by THIS process (owner keeps resource_tracker registration)
@@ -324,6 +355,10 @@ class TpuTransportSocket:
         self.endpoint = endpoint
         self.read_buf = IOBuf()
         self.preferred_protocol = None
+        # streaming parse: the in-flight PendingBodyCursor the cut loop is
+        # feeding (see rpc/protocol.py) — THIS slot is what lets credits
+        # return mid-message on the tunnel
+        self.pending_body = None
         self.failed = False
         self.error_code = 0
         self.error_text = ""
@@ -378,6 +413,7 @@ class TpuTransportSocket:
         self.failed = True
         self.error_code = code
         self.error_text = reason
+        self.pending_body = None  # half-fed body dies with the tunnel
         _vsock_pool.remove(self.socket_id)
         with self._pending_lock:
             pending = list(self._pending_ids)
@@ -586,14 +622,25 @@ class TpuEndpoint:
         return 0, False
 
     def _send_blocks(self, views, total: int):
-        """Returns (rc, partial): partial=True once any frame was posted."""
+        """Returns (rc, partial): partial=True once any frame was posted.
+
+        Two-stage pipelined loop: acquire EXACTLY the blocks the next frame
+        will fill (never speculative extras that must be released back),
+        fill them, post the frame, repeat. Posting per SEND_PIPELINE_SEGS
+        blocks instead of per message means the peer starts parsing frame k
+        while we memcpy into frame k+1's blocks — and with the receiver's
+        streaming cursor consuming mid-message, the credits for frame k are
+        often back before the last frame is filled, so a multi-window
+        message flows through a small window without stalling."""
         win = self.window
         bs = win.block_size
         sent = 0
         vi, voff = 0, 0
         while sent < total:
-            remaining_blocks = -(-(total - sent) // bs)
-            got = win.acquire(min(remaining_blocks, MAX_SEGS_PER_FRAME))
+            # exact acquire: ceil-divide what is left, capped at the
+            # pipelining quantum — every acquired block WILL carry bytes
+            need = min(-(-(total - sent) // bs), SEND_PIPELINE_SEGS)
+            got = win.acquire(need)
             if got is None:
                 # window wedged or closed
                 return errors.EOVERCROWDED, sent > 0
@@ -615,13 +662,9 @@ class TpuEndpoint:
                     if voff == len(v):
                         vi += 1
                         voff = 0
-                if blk_off:
-                    segs.append((idx, blk_off))
+                segs.append((idx, blk_off))
                 if sent >= total:
                     break
-            unused = got[len(segs):]
-            if unused:  # blocks we grabbed but didn't need go straight back
-                win.release(unused)
             body = struct.pack(DATA_BODY_HDR, 0, len(segs))
             body += b"".join(struct.pack(SEG_FMT, i, ln) for i, ln in segs)
             rc = self.ctrl.write(_pack_frame(FT_DATA, body))
@@ -690,6 +733,7 @@ class TpuEndpoint:
                     borrow = self._borrowed_outstanding < borrow_limit
                     if borrow:
                         self._borrowed_outstanding += 1
+                        _note_borrow_peak(self._borrowed_outstanding)
                 if borrow:
                     pool.add_export()
                     if vsock.read_buf.append_user_data(
@@ -787,7 +831,10 @@ class TpuEndpoint:
         # _failed already set so no ACK is queued — which usually leaves the
         # pool export-free so the close below can unmap immediately. Views
         # still held by in-flight message bodies release later; the pool
-        # defers its unmap until the last of those drops.
+        # defers its unmap until the last of those drops. A half-fed
+        # streaming cursor holds claimed bytes only (its sources were
+        # dropped at feed time) — clear the slot so nothing dispatches it.
+        self.vsock.pending_body = None
         self.vsock.read_buf.clear()
         if self.window is not None:
             self.window.close()
@@ -831,6 +878,24 @@ class TpuCtrlProtocol(Protocol):
         if not (FT_HELLO <= ftype <= FT_BYE) or blen > self.MAX_FRAME:
             return PARSE_BAD, None
         if len(buf) < CTRL_HDR_SIZE + blen:
+            from brpc_tpu.rpc.protocol import (PendingBodyCursor,
+                                               can_stream_body,
+                                               stream_body_min)
+
+            if (ftype == FT_DATA and blen >= stream_body_min()
+                    and can_stream_body(sock)):
+                # large inline DATA frame (DCN fallback) arriving in
+                # pieces: stage the body through a ref-moving cursor
+                # (claim=False — these bytes carry no deferred credits)
+                # instead of re-probing the growing read_buf every burst
+                buf.pop_front(CTRL_HDR_SIZE)
+                cursor = PendingBodyCursor(
+                    self, blen,
+                    finish=lambda cur: ParsedMessage(self, FT_DATA,
+                                                     cur.body()),
+                    claim=False)
+                cursor.feed(buf)
+                sock.pending_body = cursor
             return PARSE_NOT_ENOUGH_DATA, None
         buf.pop_front(CTRL_HDR_SIZE)
         # zero-copy crack: the body rides through as moved refs over the

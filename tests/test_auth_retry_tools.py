@@ -2,6 +2,7 @@
 in-process against loopback servers (reference pattern: tools are built on
 the public API only)."""
 
+import os
 import shutil
 import sys
 import threading
@@ -272,24 +273,28 @@ class TestTools:
         finally:
             server.stop(); server.join(timeout=2)
 
-    @pytest.mark.skipif(shutil.which("protoc") is None,
-                        reason="needs the protoc binary (the test compiles "
-                               "a user .proto at runtime)")
     def test_rpc_press_proto_json_io(self, tmp_path, capsys):
         """Reference rpc_press parity: runtime .proto compilation
-        (--proto/--inc via protoc), JSON request input, JSON response
+        (--proto/--inc via protoc — or the vendored pre-compiled descriptor
+        set on hosts without protoc), JSON request input, JSON response
         output, lb over a naming url, pooled connections, attachments."""
         sys.path.insert(0, "tools")
         from tools import rpc_press  # noqa
 
-        proto = tmp_path / "press_echo.proto"
-        proto.write_text(
-            'syntax = "proto3";\n'
-            "package press.test;\n"
-            "message Req { string message = 1; bytes payload = 2;\n"
-            "  int32 sleep_us = 3; }\n"
-            "message Resp { string message = 1; bytes payload = 2; }\n"
-            "service EchoService { rpc Echo(Req) returns (Resp); }\n")
+        if shutil.which("protoc") is not None:
+            proto = tmp_path / "press_echo.proto"
+            proto.write_text(
+                'syntax = "proto3";\n'
+                "package press.test;\n"
+                "message Req { string message = 1; bytes payload = 2;\n"
+                "  int32 sleep_us = 3; }\n"
+                "message Resp { string message = 1; bytes payload = 2; }\n"
+                "service EchoService { rpc Echo(Req) returns (Resp); }\n")
+            method_args = ["--proto", str(proto)]
+        else:
+            desc = os.path.join(os.path.dirname(__file__), "data",
+                                "press_echo.desc")
+            method_args = ["--descriptor-set", desc]
         inp = tmp_path / "reqs.json"
         inp.write_text('{"message": "a"}\n{"message": "b"}\n')
         outp = tmp_path / "resps.json"
@@ -298,7 +303,7 @@ class TestTools:
             rc = rpc_press.main([
                 "--server", f"list://{server.listen_endpoint()}",
                 "--lb-policy", "rr",
-                "--proto", str(proto),
+                *method_args,
                 "--full-method", "press.test.EchoService.Echo",
                 "--input", str(inp), "--output", str(outp),
                 "--connection-type", "pooled",

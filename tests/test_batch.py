@@ -160,6 +160,25 @@ class TestQueueFlush:
         assert _wait(lambda: len(results) == 3, 3.0)
         assert seen == [(3, 4)]  # 3 live items padded to the 4-bucket
 
+    def test_pad_waste_recorded_per_bucket(self):
+        """Every flush records bucket - size into the per-bucket pad-waste
+        recorder, surfaced on /vars as g_batch_pad_waste_<bucket> — the
+        signal for tuning bucket_shapes against real traffic."""
+        from brpc_tpu.metrics.variable import get_exposed
+
+        bm = make_batched(
+            "t.waste", lambda b: ["ok"] * b.size,
+            max_batch_size=8, max_delay_us=5000, flush_on_poll_batch=False)
+        results = []
+        _drive(bm, 3, results)           # size 3 -> bucket 4 -> waste 1
+        assert _wait(lambda: len(results) == 3, 3.0)
+        waste_sum, waste_count = bmetrics.pad_waste_buckets()[4].get_value()
+        assert waste_count >= 1 and waste_sum >= 1
+        var = get_exposed("g_batch_pad_waste_4")
+        assert var is not None
+        rendered = var.describe()
+        assert "count=" in rendered, rendered
+
 
 class TestIsolation:
     def test_one_bad_request_fails_alone(self):

@@ -111,3 +111,33 @@ def test_zero_copy_counters_emitted(bench_run):
     borrowed = int(zc[0].split("borrowed=")[1].split("B")[0].replace(",", ""))
     assert borrowed > 0, zc[0]
     assert any(l.startswith("# tpu:// ack batching") for l in err.splitlines())
+
+
+def test_shrunken_window_peak_report(bench_run):
+    """The streaming-parse sweep lane: bench_tpu_sweep reports (and guards)
+    peak borrowed-outstanding against the shrunken 64-block window."""
+    err = bench_run.stderr
+    peaks = [l for l in err.splitlines()
+             if l.startswith("# tpu:// borrowed peak:")]
+    assert peaks, err[-2000:]
+    line = peaks[0]
+    peak = int(line.split("borrowed peak:")[1].split("blocks")[0])
+    window = int(line.split("(window")[1].split(")")[0])
+    assert window == 64, line
+    from brpc_tpu.butil.iobuf import supports_block_ownership
+
+    if supports_block_ownership():
+        # the whole point of streaming claims: the footprint never
+        # approaches the window even with 16MB messages in the sweep
+        assert peak < window, line
+
+
+def test_tunnel_counters_on_vars(bench_run):
+    """The zero-copy counters must be queryable through the /vars surface
+    (expose registry), not just printed by bench.py."""
+    from brpc_tpu.metrics.variable import get_exposed
+    from brpc_tpu.tpu import transport  # noqa: F401  (registers on import)
+
+    for name in ("g_tunnel_borrowed_bytes", "g_tunnel_copied_bytes",
+                 "g_tunnel_borrowed_peak_blocks"):
+        assert get_exposed(name) is not None, name

@@ -1,0 +1,503 @@
+"""Streaming parse pipeline tests — mid-message credit return.
+
+The tentpole contract: once a protocol cracks a header it registers a
+pending-body cursor on the socket, the cut loop feeds arriving bytes into
+it without re-running parse, and on the tpu:// tunnel each borrowed block's
+FT_ACK credit returns as soon as ITS bytes are claimed — mid-message. These
+tests pin that behavior at three levels: the cursor/cut-loop unit level,
+the endpoint level (generic and native cut paths), and end-to-end over a
+loopback tunnel where a message LARGER than the whole credit window must
+flow borrowed-only — impossible unless credits return mid-message.
+"""
+
+import time
+
+import pytest
+
+from brpc_tpu import flags as _flags
+from brpc_tpu.butil.iobuf import IOBuf, supports_block_ownership
+from brpc_tpu.proto import echo_pb2
+from brpc_tpu.rpc import (
+    Channel,
+    ChannelOptions,
+    Server,
+    ServerOptions,
+    Service,
+    Stub,
+)
+
+from test_tpu_transport import (
+    _acked_indices,
+    _data_frame_body,
+    _make_endpoint,
+    _trpc_response_packet,
+)
+
+ECHO = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+
+class EchoServiceImpl(Service):
+    DESCRIPTOR = ECHO
+
+    def Echo(self, cntl, request, done):
+        cntl.response_attachment = cntl.request_attachment
+        return echo_pb2.EchoResponse(message=request.message,
+                                     payload=request.payload)
+
+
+@pytest.fixture()
+def tpu_server():
+    server = Server(ServerOptions())
+    server.add_service(EchoServiceImpl())
+    server.start("tpu://127.0.0.1:0/0")
+    yield server
+    server.stop()
+    server.join()
+
+
+def _stub_for(server, timeout_ms=30000):
+    channel = Channel(ChannelOptions(protocol="trpc_std",
+                                     timeout_ms=timeout_ms))
+    channel.init(str(server.listen_endpoint()))
+    return Stub(channel, ECHO)
+
+
+@pytest.fixture()
+def small_stream_min():
+    """Lower the streaming threshold so unit tests can use small bodies."""
+    old = _flags.get("stream_body_min_bytes")
+    _flags.set_flag("stream_body_min_bytes", "4096")
+    yield 4096
+    _flags.set_flag("stream_body_min_bytes", str(old))
+
+
+# ---------------------------------------------------------------------------
+# cursor unit level
+# ---------------------------------------------------------------------------
+class _FakeParseSock:
+    """Just enough socket surface for InputMessenger.cut_messages."""
+
+    def __init__(self):
+        self.read_buf = IOBuf()
+        self.preferred_protocol = None
+        self.pending_body = None
+        self.failed = False
+        self.in_messages = 0
+        self.owner_server = None
+        self.user_data = None
+
+    def remove_pending_id(self, cid):
+        return False
+
+    def set_failed(self, code, reason=""):
+        self.failed = True
+        self.pending_body = None
+
+
+class TestCursorUnit:
+    def test_cutn_into_buffer_copies_and_pops(self):
+        buf = IOBuf()
+        buf.append(b"abcdef")
+        buf.append(b"ghij")
+        dest = bytearray(7)
+        assert buf.cutn_into_buffer(7, memoryview(dest)) == 7
+        assert bytes(dest) == b"abcdefg"
+        assert buf.tobytes() == b"hij"
+
+    def test_cutn_into_buffer_fires_release_hooks(self):
+        if not supports_block_ownership():
+            pytest.skip("no block-ownership exporter in this environment")
+        released = []
+        src = bytearray(b"x" * 100)
+        buf = IOBuf()
+        buf.append_user_data(memoryview(src),
+                            release=lambda: released.append(1))
+        dest = bytearray(100)
+        buf.cutn_into_buffer(40, memoryview(dest)[:40])
+        assert released == []          # 60 bytes of the block still queued
+        buf.cutn_into_buffer(60, memoryview(dest)[40:])
+        assert released == [1]         # last ref died AT claim time
+        assert bytes(dest) == b"x" * 100
+
+    def test_cursor_survives_not_enough_rounds(self, small_stream_min):
+        """A trpc_std body drip-fed through many PARSE_NOT_ENOUGH_DATA-sized
+        pieces keeps ONE cursor alive across rounds, never re-parses the
+        header, and completes into a normally-dispatched message."""
+        from brpc_tpu.policy import ensure_registered
+        from brpc_tpu.rpc.input_messenger import InputMessenger
+
+        ensure_registered()
+        pkt = _trpc_response_packet(b"\x5c" * 16384)
+        sock = _FakeParseSock()
+        messenger = InputMessenger()
+        cursor_seen = set()
+        remaining_trace = []
+        step = 7
+        for off in range(0, len(pkt), step):
+            sock.read_buf.append(pkt[off:off + step])
+            messenger.cut_messages(sock)
+            if sock.pending_body is not None:
+                cursor_seen.add(id(sock.pending_body))
+                remaining_trace.append(sock.pending_body.remaining)
+        assert not sock.failed, (sock.failed,)
+        assert len(cursor_seen) == 1          # one cursor, surviving rounds
+        assert remaining_trace == sorted(remaining_trace, reverse=True)
+        assert sock.pending_body is None      # completed and dispatched
+        assert sock.in_messages == 1
+        assert len(sock.read_buf) == 0
+
+    def test_small_bodies_never_register_a_cursor(self):
+        from brpc_tpu.policy import ensure_registered
+        from brpc_tpu.rpc.input_messenger import InputMessenger
+
+        ensure_registered()
+        pkt = _trpc_response_packet(b"s" * 512)  # far below the threshold
+        sock = _FakeParseSock()
+        messenger = InputMessenger()
+        sock.read_buf.append(pkt[:40])
+        messenger.cut_messages(sock)
+        assert sock.pending_body is None
+        sock.read_buf.append(pkt[40:])
+        messenger.cut_messages(sock)
+        assert sock.in_messages == 1
+
+    def test_tpuc_frame_streams_through_cursor(self, small_stream_min):
+        """TPUC DATA frames (DCN inline fallback) stage large bodies through
+        a ref-moving cursor instead of re-probing a growing read_buf."""
+        import struct
+
+        from brpc_tpu.tpu import transport as tr
+
+        proto = tr.TpuCtrlProtocol()
+        body = b"\xa5" * 8192
+        frame = struct.pack(tr.CTRL_HDR, tr.CTRL_MAGIC, tr.FT_DATA,
+                            len(body)) + body
+        sock = _FakeParseSock()
+        buf = sock.read_buf
+        buf.append(frame[:2000])
+        rc, msg = proto.parse(buf, sock)
+        assert rc == 1 and msg is None        # PARSE_NOT_ENOUGH_DATA
+        cursor = sock.pending_body
+        assert cursor is not None and cursor.total == len(body)
+        assert len(buf) == 0                  # arrived bytes already claimed
+        buf.append(frame[2000:])
+        cursor.feed(buf)
+        assert cursor.done
+        done = cursor.finish()
+        assert done.meta == tr.FT_DATA
+        assert done.body.tobytes() == body
+
+    def test_http_content_length_body_streams(self, small_stream_min):
+        from brpc_tpu.policy.http_protocol import HttpProtocol
+
+        body = b"Z" * 10000
+        raw = (b"POST /svc/m HTTP/1.1\r\nHost: h\r\n"
+               b"Content-Length: 10000\r\n\r\n") + body
+        proto = HttpProtocol()
+        sock = _FakeParseSock()
+        sock.read_buf.append(raw[:100])
+        rc, msg = proto.parse(sock.read_buf, sock)
+        assert rc == 1 and sock.pending_body is not None
+        sock.read_buf.append(raw[100:])
+        cursor = sock.pending_body
+        cursor.feed(sock.read_buf)
+        assert cursor.done
+        parsed = cursor.finish()
+        assert parsed.meta.body == body
+        assert parsed.body.tobytes() == body
+
+    def test_http_fetch_path_keeps_whole_message_semantics(self):
+        # standalone parse (no sock/proto) must never register a cursor
+        from brpc_tpu.policy.http_protocol import parse_http_message
+
+        raw = (b"HTTP/1.1 200 OK\r\nContent-Length: 10\r\n\r\n" + b"x" * 4)
+        buf = IOBuf(raw)
+        rc, msg = parse_http_message(buf)
+        assert rc == 1 and msg is None
+        assert len(buf) == len(raw)           # nothing consumed
+
+
+# ---------------------------------------------------------------------------
+# endpoint level: the mid-message ACK itself
+# ---------------------------------------------------------------------------
+class TestMidMessageCreditReturn:
+    def _stream_packet_through(self, tr, fake, ep, pkt):
+        """Write pkt across pool blocks and deliver one DATA frame per
+        block, returning the list of (acked_so_far, message_done) after
+        each frame."""
+        pool = ep.recv_pool
+        bs = pool.block_size
+        trace = []
+        nblocks = -(-len(pkt) // bs)
+        for b in range(nblocks):
+            chunk = pkt[b * bs:(b + 1) * bs]
+            pool._shm.buf[b * bs:b * bs + len(chunk)] = chunk
+            ep.on_data(IOBuf(_data_frame_body([(b, len(chunk))])))
+            acked = [i for fr in _acked_indices(fake) for i in fr]
+            trace.append((list(acked),
+                          ep.vsock.pending_body is None))
+        return trace
+
+    def test_ack_returns_before_message_completes_generic_path(self):
+        """THE tentpole regression: with the generic (_cut_one) cut path,
+        at least one credit is ACKed while the message is still mid-body."""
+        if not supports_block_ownership():
+            pytest.skip("no block-ownership exporter in this environment")
+        tr, fake, ep = _make_endpoint()
+        try:
+            # 300KB body ≥ stream_body_min (256KB), 64KB blocks → 5 frames
+            pkt = _trpc_response_packet(b"\xcd" * (300 * 1024))
+            trace = self._stream_packet_through(tr, fake, ep, pkt)
+            # after the FIRST frame the message is incomplete (cursor
+            # registered) yet its block's credit is already on the wire
+            first_acked, first_done = trace[0]
+            assert not first_done, "message must still be mid-body"
+            assert 0 in first_acked, \
+                f"block 0 credit not returned mid-message: {trace}"
+            # message eventually completes and every block ACKs exactly once
+            assert trace[-1][1], trace
+            final = sorted(trace[-1][0])
+            assert final == list(range(len(trace))), trace
+        finally:
+            ep.fail(0, "test done")
+
+    def test_ack_returns_mid_message_native_cut_path(self):
+        """Same contract with the native batch scanner active on the vsock
+        (preferred protocol TRPC + complete plain frames batch-scanned):
+        the scanner must neither swallow the cursor nor re-copy borrowed
+        bytes, and credits still return mid-message."""
+        if not supports_block_ownership():
+            pytest.skip("no block-ownership exporter in this environment")
+        from brpc_tpu.rpc.protocol import find_protocol
+
+        tr, fake, ep = _make_endpoint()
+        try:
+            ep.vsock.preferred_protocol = find_protocol("trpc_std")
+            # stage 1: two complete small messages arrive INLINE (plain
+            # refs — the native scanner's territory) in one frame
+            small = _trpc_response_packet(b"a" * 64)
+            inline = small + small
+            import struct
+
+            body = struct.pack(tr.DATA_BODY_HDR, len(inline), 0) + inline
+            ep.on_data(IOBuf(body))
+            assert ep.vsock.in_messages == 2
+            # stage 2: a large blocked message streams through the SAME
+            # socket — the scanner bails (owned blocks / incomplete head),
+            # the generic path registers the cursor, credits flow mid-body
+            pkt = _trpc_response_packet(b"\x77" * (300 * 1024))
+            trace = self._stream_packet_through(tr, fake, ep, pkt)
+            first_acked, first_done = trace[0]
+            assert not first_done
+            assert 0 in first_acked, trace
+            assert trace[-1][1]
+            assert sorted(trace[-1][0]) == list(range(len(trace)))
+        finally:
+            ep.fail(0, "test done")
+
+    def test_native_batcher_defers_to_pending_cursor(self):
+        from brpc_tpu.rpc.protocol import find_protocol
+
+        tr, fake, ep = _make_endpoint()
+        try:
+            sock = _FakeParseSock()
+            sock.preferred_protocol = find_protocol("trpc_std")
+            sock.pending_body = object()  # any live cursor
+            sock.read_buf.append(_trpc_response_packet(b"y" * 64))
+            assert ep._messenger._cut_batch_native(sock) is None
+        finally:
+            ep.fail(0, "test done")
+
+    def test_borrowed_outstanding_stays_low_while_streaming(self):
+        """The whole point of the shrunken window: claiming at arrival
+        keeps the in-flight borrow footprint at one frame's worth, not one
+        message's worth."""
+        if not supports_block_ownership():
+            pytest.skip("no block-ownership exporter in this environment")
+        tr, fake, ep = _make_endpoint()
+        try:
+            pkt = _trpc_response_packet(b"\x11" * (300 * 1024))
+            pool = ep.recv_pool
+            bs = pool.block_size
+            peak = 0
+            for b in range(-(-len(pkt) // bs)):
+                chunk = pkt[b * bs:(b + 1) * bs]
+                pool._shm.buf[b * bs:b * bs + len(chunk)] = chunk
+                ep.on_data(IOBuf(_data_frame_body([(b, len(chunk))])))
+                peak = max(peak, ep._borrowed_outstanding)
+            # 5-block message, but never more than one block outstanding
+            # after a cut (the cursor claims each arrival inside the cut)
+            assert peak <= 1, peak
+        finally:
+            ep.fail(0, "test done")
+
+
+# ---------------------------------------------------------------------------
+# send side: pipelined two-stage loop with exact acquire
+# ---------------------------------------------------------------------------
+class TestSendPipelining:
+    def _frames_of(self, tr, fake, ftype):
+        import struct
+
+        out = []
+        for raw in fake.frames:
+            magic, ft, blen = struct.unpack_from(tr.CTRL_HDR, raw)
+            if ft == ftype:
+                out.append(raw[tr.CTRL_HDR_SIZE:tr.CTRL_HDR_SIZE + blen])
+        return out
+
+    def test_exact_acquire_and_frame_quantum(self):
+        import struct
+
+        tr, fake, ep = _make_endpoint()
+        try:
+            # attach a window over our own pool: 8 blocks of 64KB
+            ep.window = tr.PeerWindow(ep.recv_pool.name,
+                                      ep.recv_pool.block_size,
+                                      ep.recv_pool.block_count)
+            payload = b"\x3c" * (300 * 1024)  # 5 blocks
+            rc = ep.send_packet(IOBuf(payload))
+            assert rc == 0
+            datas = self._frames_of(tr, fake, tr.FT_DATA)
+            seg_lens = []
+            for body in datas:
+                inline_len, nsegs = struct.unpack_from(tr.DATA_BODY_HDR, body)
+                assert inline_len == 0
+                assert 1 <= nsegs <= tr.SEND_PIPELINE_SEGS
+                for k in range(nsegs):
+                    idx, ln = struct.unpack_from(
+                        tr.SEG_FMT, body, tr.DATA_BODY_HDR_SIZE + 8 * k)
+                    assert ln > 0          # exact acquire: no empty segs
+                    seg_lens.append(ln)
+            assert sum(seg_lens) == len(payload)
+            # 5 blocks at a 4-block quantum → 2 frames: the peer starts
+            # parsing frame 1 while frame 2's blocks are being filled
+            assert len(datas) == 2, [len(d) for d in datas]
+            # every acquired credit is spoken for: 8 - 5 remain free
+            with ep.window._cond:
+                assert len(ep.window._free) == 3
+        finally:
+            ep.fail(0, "test done")
+
+
+# ---------------------------------------------------------------------------
+# end to end: a message larger than the WHOLE window flows borrowed-only
+# ---------------------------------------------------------------------------
+class TestShrunkWindowEndToEnd:
+    def test_negotiated_window_is_64_blocks(self, tpu_server):
+        from brpc_tpu.tpu import transport as tr
+
+        assert tr.DEFAULT_BLOCK_COUNT == 64
+        stub = _stub_for(tpu_server)
+        stub.Echo(echo_pb2.EchoRequest(message="hello"))
+        with tr._remote_lock:
+            vs = next(s for s in tr._remote_sockets.values() if not s.failed)
+        assert vs.endpoint.window.block_count == 64
+
+    def test_16mb_sweep_regression_copied_fraction(self, tpu_server):
+        """The PR-2 guard at the SHRUNKEN window: a 16MB echo (16MB request
+        + 16MB response = 128 blocks against a 64-block window) must stay
+        ≤10% copied. Only mid-message credit return makes this possible —
+        without it the borrow budget overflows and bytes fall back to
+        copy-and-ACK."""
+        from brpc_tpu.tpu import transport as tr
+
+        if not supports_block_ownership():
+            pytest.skip("no block-ownership exporter in this environment")
+        stub = _stub_for(tpu_server, timeout_ms=60000)
+        payload = b"\x42" * (16 * 1024 * 1024)
+        stub.Echo(echo_pb2.EchoRequest(message="warm", payload=payload))
+        borrowed0 = tr.g_tunnel_borrowed_bytes.get_value()
+        copied0 = tr.g_tunnel_copied_bytes.get_value()
+        r = stub.Echo(echo_pb2.EchoRequest(message="sweep", payload=payload))
+        assert r.payload == payload
+        borrowed = tr.g_tunnel_borrowed_bytes.get_value() - borrowed0
+        copied = tr.g_tunnel_copied_bytes.get_value() - copied0
+        assert borrowed > 0
+        frac = copied / max(1, borrowed + copied)
+        assert frac <= 0.10, (borrowed, copied, frac)
+        # ... and at no point did the borrow footprint approach the window
+        assert tr.borrowed_peak_blocks() < tr.DEFAULT_BLOCK_COUNT, \
+            tr.borrowed_peak_blocks()
+
+    def test_sender_reuses_credits_mid_message(self, tpu_server):
+        """E2E mid-message proof from the SENDER's side: a 24MB payload is
+        96 blocks — more than the whole 64-block window — so the send can
+        only complete if credits the receiver returned MID-message were
+        re-acquired. copied==0 rules out the copy-and-ACK fallback having
+        supplied them."""
+        from brpc_tpu.tpu import transport as tr
+
+        if not supports_block_ownership():
+            pytest.skip("no block-ownership exporter in this environment")
+        stub = _stub_for(tpu_server, timeout_ms=60000)
+        payload = b"\x99" * (24 * 1024 * 1024)
+        copied0 = tr.g_tunnel_copied_bytes.get_value()
+        r = stub.Echo(echo_pb2.EchoRequest(message="wrap", payload=payload))
+        assert r.payload == payload
+        assert tr.g_tunnel_copied_bytes.get_value() - copied0 == 0
+
+    def test_offloaded_cut_path_streams_mid_message(self, tpu_server):
+        """Force the bootstrap socket's cut loop onto the offloaded fiber
+        cutter (tiny inline_cut_max_bytes) and re-prove the window-wrap:
+        > 64 blocks of payload with zero copied bytes means credits
+        returned mid-message on the offloaded path too."""
+        from brpc_tpu.tpu import transport as tr
+
+        if not supports_block_ownership():
+            pytest.skip("no block-ownership exporter in this environment")
+        old = _flags.get("inline_cut_max_bytes")
+        _flags.set_flag("inline_cut_max_bytes", "8192")
+        try:
+            stub = _stub_for(tpu_server, timeout_ms=60000)
+            payload = b"\x77" * (20 * 1024 * 1024)  # 80 blocks > 64 window
+            copied0 = tr.g_tunnel_copied_bytes.get_value()
+            r = stub.Echo(echo_pb2.EchoRequest(message="off",
+                                               payload=payload))
+            assert r.payload == payload
+            assert tr.g_tunnel_copied_bytes.get_value() - copied0 == 0
+        finally:
+            _flags.set_flag("inline_cut_max_bytes", str(old))
+
+
+# ---------------------------------------------------------------------------
+# teardown semantics
+# ---------------------------------------------------------------------------
+class TestCursorTeardown:
+    def test_socket_failure_drops_cursor(self, small_stream_min):
+        from brpc_tpu.policy import ensure_registered
+        from brpc_tpu.rpc.input_messenger import InputMessenger
+
+        ensure_registered()
+        pkt = _trpc_response_packet(b"\xdd" * 16384)
+        sock = _FakeParseSock()
+        messenger = InputMessenger()
+        sock.read_buf.append(pkt[:8000])
+        messenger.cut_messages(sock)
+        assert sock.pending_body is not None
+        sock.set_failed(1001, "teardown")
+        assert sock.pending_body is None
+
+    def test_endpoint_fail_mid_cursor_releases_everything(self):
+        if not supports_block_ownership():
+            pytest.skip("no block-ownership exporter in this environment")
+        tr, fake, ep = _make_endpoint()
+        pkt = _trpc_response_packet(b"\xee" * (300 * 1024))
+        pool = ep.recv_pool
+        bs = pool.block_size
+        # deliver only the first two of five blocks, then kill the tunnel
+        for b in range(2):
+            chunk = pkt[b * bs:(b + 1) * bs]
+            pool._shm.buf[b * bs:b * bs + len(chunk)] = chunk
+            ep.on_data(IOBuf(_data_frame_body([(b, len(chunk))])))
+        assert ep.vsock.pending_body is not None
+        ep.fail(999, "mid-cursor teardown")
+        assert ep.vsock.pending_body is None
+        # the claimed bytes' source blocks were already released at feed
+        # time; teardown leaves no exports pinning the pool
+        deadline = time.monotonic() + 5
+        while pool.exports and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pool.exports == 0
+        tr._sweep_deferred_pools()
+        assert pool._closed

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Regenerate tests/data/press_echo.desc — the vendored descriptor set the
+rpc_press proto test falls back to on hosts without a protoc binary.
+
+The set is equivalent to compiling:
+
+    syntax = "proto3";
+    package press.test;
+    message Req  { string message = 1; bytes payload = 2; int32 sleep_us = 3; }
+    message Resp { string message = 1; bytes payload = 2; }
+    service EchoService { rpc Echo(Req) returns (Resp); }
+
+built here from FileDescriptorProto primitives so regeneration itself needs
+no protoc either.
+"""
+
+import os
+import sys
+
+from google.protobuf import descriptor_pb2
+
+F = descriptor_pb2.FieldDescriptorProto
+
+
+def _field(msg, name, number, ftype):
+    f = msg.field.add()
+    f.name = name
+    f.number = number
+    f.type = ftype
+    f.label = F.LABEL_OPTIONAL
+    f.json_name = name
+    return f
+
+
+def build() -> descriptor_pb2.FileDescriptorSet:
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = "press_echo.proto"
+    fdp.package = "press.test"
+    fdp.syntax = "proto3"
+
+    req = fdp.message_type.add()
+    req.name = "Req"
+    _field(req, "message", 1, F.TYPE_STRING)
+    _field(req, "payload", 2, F.TYPE_BYTES)
+    _field(req, "sleep_us", 3, F.TYPE_INT32)
+
+    resp = fdp.message_type.add()
+    resp.name = "Resp"
+    _field(resp, "message", 1, F.TYPE_STRING)
+    _field(resp, "payload", 2, F.TYPE_BYTES)
+
+    svc = fdp.service.add()
+    svc.name = "EchoService"
+    meth = svc.method.add()
+    meth.name = "Echo"
+    meth.input_type = ".press.test.Req"
+    meth.output_type = ".press.test.Resp"
+
+    fds = descriptor_pb2.FileDescriptorSet()
+    fds.file.append(fdp)
+    return fds
+
+
+def main() -> int:
+    out = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "data", "press_echo.desc")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "wb") as f:
+        f.write(build().SerializeToString())
+    print(f"wrote {out} ({os.path.getsize(out)} bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

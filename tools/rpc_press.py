@@ -25,6 +25,25 @@ from brpc_tpu.rpc import Channel, ChannelOptions, Controller, MethodDescriptor
 from brpc_tpu.rpc.channel import RawMessage
 
 
+def _method_from_fds(fds, full_method: str):
+    """Resolve pkg.Service.Method out of a FileDescriptorSet into a callable
+    MethodDescriptor (dynamic request/response classes)."""
+    from google.protobuf import descriptor_pool, message_factory
+
+    pool = descriptor_pool.DescriptorPool()
+    for fd in fds.file:
+        pool.Add(fd)
+    svc_full, _, meth_name = full_method.rpartition(".")
+    svc = pool.FindServiceByName(svc_full)
+    mdesc = svc.methods_by_name[meth_name]
+    return MethodDescriptor(
+        service_name=svc.name, method_name=meth_name,
+        request_class=message_factory.GetMessageClass(
+            pool.FindMessageTypeByName(mdesc.input_type.full_name)),
+        response_class=message_factory.GetMessageClass(
+            pool.FindMessageTypeByName(mdesc.output_type.full_name)))
+
+
 def load_proto_method(proto_path: str, incs: str, full_method: str):
     """Compile a user .proto with protoc and resolve pkg.Service.Method —
     the reference presses arbitrary services the same way (its
@@ -32,8 +51,7 @@ def load_proto_method(proto_path: str, incs: str, full_method: str):
     import subprocess
     import tempfile
 
-    from google.protobuf import descriptor_pb2, descriptor_pool
-    from google.protobuf import message_factory
+    from google.protobuf import descriptor_pb2
 
     with tempfile.NamedTemporaryFile(suffix=".ds", delete=False) as tmp:
         ds_path = tmp.name
@@ -50,19 +68,18 @@ def load_proto_method(proto_path: str, incs: str, full_method: str):
     with open(ds_path, "rb") as f:
         fds = descriptor_pb2.FileDescriptorSet.FromString(f.read())
     os.unlink(ds_path)
-    pool = descriptor_pool.DescriptorPool()
-    for fd in fds.file:
-        pool.Add(fd)
-    svc_full, _, meth_name = full_method.rpartition(".")
-    svc = pool.FindServiceByName(svc_full)
-    mdesc = svc.methods_by_name[meth_name]
-    md = MethodDescriptor(
-        service_name=svc.name, method_name=meth_name,
-        request_class=message_factory.GetMessageClass(
-            pool.FindMessageTypeByName(mdesc.input_type.full_name)),
-        response_class=message_factory.GetMessageClass(
-            pool.FindMessageTypeByName(mdesc.output_type.full_name)))
-    return md
+    return _method_from_fds(fds, full_method)
+
+
+def load_descriptor_method(ds_path: str, full_method: str):
+    """Resolve pkg.Service.Method from a pre-compiled descriptor set
+    (protoc --descriptor_set_out, or any vendored .desc) — presses run on
+    hosts without a protoc binary."""
+    from google.protobuf import descriptor_pb2
+
+    with open(ds_path, "rb") as f:
+        fds = descriptor_pb2.FileDescriptorSet.FromString(f.read())
+    return _method_from_fds(fds, full_method)
 
 
 def load_input_requests(path: str, request_class):
@@ -84,9 +101,12 @@ def load_input_requests(path: str, request_class):
 
 
 def build_method(args) -> tuple:
-    if args.proto:
-        md = load_proto_method(args.proto, args.inc, args.full_method
-                               or f"{args.service}.{args.method}")
+    if args.proto or args.descriptor_set:
+        full = args.full_method or f"{args.service}.{args.method}"
+        if args.descriptor_set:
+            md = load_descriptor_method(args.descriptor_set, full)
+        else:
+            md = load_proto_method(args.proto, args.inc, full)
         if args.input:
             reqs = load_input_requests(args.input, md.request_class)
         else:
@@ -127,6 +147,9 @@ def main(argv=None) -> int:
                    help="raw serialized request body")
     p.add_argument("--proto", default=None,
                    help="user .proto file (compiled via protoc at runtime)")
+    p.add_argument("--descriptor-set", default=None,
+                   help="pre-compiled FileDescriptorSet (.desc) — like "
+                        "--proto but needs no protoc on this host")
     p.add_argument("--inc", default="",
                    help="include paths for --proto, ';'-separated")
     p.add_argument("--input", default=None,
