@@ -246,6 +246,19 @@ class _BoundBatchedMethod:
         self.__name__ = name
 
     def __call__(self, cntl, request, done):
+        # server-side deadline: don't enqueue work whose client budget is
+        # already spent — it would occupy a batch slot only to have its
+        # response dropped by the caller
+        dl = getattr(cntl, "deadline_mono", 0.0)
+        if dl and time.monotonic() >= dl:
+            from brpc_tpu.rpc.server_processing import \
+                g_server_deadline_expired
+
+            g_server_deadline_expired.put(1)
+            cntl.set_failed(errors.ERPCTIMEDOUT,
+                            "request deadline already spent before batch "
+                            "enqueue")
+            return None
         rc = self.queue.admit(BatchItem(cntl, request, done))
         if rc != 0:
             cntl.set_failed(rc, f"batch queue {self.queue.name} over "

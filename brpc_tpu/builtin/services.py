@@ -303,6 +303,48 @@ def rpcz_service(server, http: HttpMessage):
     return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
 
 
+# --------------------------------------------------------------------- fault
+def fault_service(server, http: HttpMessage):
+    """Chaos console: inspect / arm / disarm injection points at runtime.
+
+    GET /fault                     registry snapshot (JSON)
+    GET /fault/arm?point=X&...     arm (mode=/after=/count=/match_*/params)
+    GET /fault/disarm?point=X      disarm one point
+    GET /fault/disarm_all          disarm everything
+
+    Arming only changes specs — nothing fires until the master switch
+    ``fault_injection_enabled`` is on (flip via /flags)."""
+    from brpc_tpu import fault as _fault
+
+    sub = _sub_path(http)
+    if sub == "arm":
+        point = http.query.get("point", "")
+        if not point:
+            return 400, CONTENT_TEXT, "arm wants ?point=<name>\n"
+        try:
+            _fault.parse_spec_kv(point, dict(http.query))
+        except (ValueError, TypeError) as e:
+            return 400, CONTENT_TEXT, f"bad spec: {e}\n"
+        return 200, CONTENT_TEXT, f"armed {point}\n"
+    if sub == "disarm":
+        point = http.query.get("point", "")
+        if not point:
+            return 400, CONTENT_TEXT, "disarm wants ?point=<name>\n"
+        if not _fault.disarm(point):
+            return 404, CONTENT_TEXT, f"{point} was not armed\n"
+        return 200, CONTENT_TEXT, f"disarmed {point}\n"
+    if sub == "disarm_all":
+        n = _fault.disarm_all()
+        return 200, CONTENT_TEXT, f"disarmed {n} points\n"
+    if sub:
+        return 404, CONTENT_TEXT, f"no /fault/{sub}\n"
+    body = json.dumps({
+        "enabled": bool(_flags.get("fault_injection_enabled")),
+        "points": _fault.snapshot(),
+    }, indent=2)
+    return 200, CONTENT_JSON, body + "\n"
+
+
 # -------------------------------------------------------------------- logoff
 def logoff_service(server, http: HttpMessage):
     if server is None:
@@ -330,3 +372,5 @@ register_builtin("rpcz", rpcz_service, "recent rpc spans (/rpcz/<trace_id>)")
 register_builtin("logoff", logoff_service, "stop accepting new requests")
 register_builtin("vlog", vlog_service,
                  "verbose-log sites (/vlog?setlevel=module=N)")
+register_builtin("fault", fault_service,
+                 "fault injection points (/fault/arm?point=<name>)")

@@ -35,6 +35,19 @@ class RetryOnCodes(RetryPolicy):
         return controller.error_code in self.codes
 
 
+class TunnelRetryPolicy(RetryOnCodes):
+    """Retry posture for tpu:// tunnel clients.
+
+    On top of the connection-level set (which a tunnel kill maps onto via
+    the transport's retriable-code fanout), also retries EOVERCROWDED:
+    during a heal the rebuilt window starts empty, so the first calls can
+    race a still-wedged credit ledger — re-issuing lands them on the fresh
+    epoch instead of surfacing a transient overload."""
+
+    def __init__(self, include_default: bool = True):
+        super().__init__({errors.EOVERCROWDED}, include_default)
+
+
 class BackupRequestPolicy:
     """Decides whether a backup (hedged) request fires for this call
     (reference backup_request_policy.h)."""

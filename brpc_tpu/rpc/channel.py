@@ -225,7 +225,16 @@ class Channel:
                     return dp.get_or_connect(ep, timeout_ms)
             from brpc_tpu.tpu.tpusocket import get_tpu_socket
 
-            return get_tpu_socket(ep)
+            # deadline-aware dial: a healing tunnel may retry-with-backoff
+            # inside connect — bound that by the call's remaining budget so
+            # a short-timeout RPC fails fast instead of riding the full
+            # connect_timeout worth of re-handshake attempts
+            connect_s = timeout_ms / 1000.0
+            call_ms = getattr(cntl, "timeout_ms", 0) if cntl is not None \
+                else 0
+            if call_ms and call_ms > 0:
+                connect_s = min(connect_s, call_ms / 1000.0)
+            return get_tpu_socket(ep, connect_timeout=connect_s)
         if (self.options.native_transport and not ep.is_unix()
                 and self.options.ssl is None
                 and getattr(self._protocol, "name", "") == "grpc"):

@@ -19,16 +19,22 @@ class CircuitBreaker:
                  error_threshold: float = 0.5,
                  min_samples: int = 10,
                  base_isolation_s: float = 0.1,
-                 max_isolation_s: float = 30.0):
+                 max_isolation_s: float = 30.0,
+                 fail_streak_trip: int = 0):
         self.error_threshold = error_threshold
         self.min_samples = min_samples
         self.base_isolation_s = base_isolation_s
         self.max_isolation_s = max_isolation_s
+        # >0: trip after this many CONSECUTIVE failures, independent of the
+        # EMA windows — for low-rate probe traffic (tunnel re-handshakes)
+        # where tens of samples would take forever to accumulate
+        self.fail_streak_trip = fail_streak_trip
         self._lock = threading.Lock()
         # EMAs: long window reacts slowly, short window catches bursts
         self._long_ema = 0.0
         self._short_ema = 0.0
         self._samples = 0
+        self._fail_streak = 0
         self._isolated_until = 0.0
         self._isolation_s = base_isolation_s
 
@@ -38,10 +44,14 @@ class CircuitBreaker:
             self._samples += 1
             self._long_ema += 0.02 * (err - self._long_ema)
             self._short_ema += 0.2 * (err - self._short_ema)
-            if (self._samples >= self.min_samples
-                    and not self._is_isolated_locked()
-                    and (self._short_ema > self.error_threshold
-                         or self._long_ema > self.error_threshold)):
+            self._fail_streak = self._fail_streak + 1 if err else 0
+            if (not self._is_isolated_locked()
+                    and ((self._samples >= self.min_samples
+                          and (self._short_ema > self.error_threshold
+                               or self._long_ema > self.error_threshold))
+                         or (self.fail_streak_trip > 0
+                             and self._fail_streak >=
+                             self.fail_streak_trip))):
                 self._trip_locked()
             elif err == 0.0 and not self._is_isolated_locked():
                 # healthy traffic decays the penalty
@@ -57,6 +67,7 @@ class CircuitBreaker:
         self._short_ema = 0.0
         self._long_ema = 0.0
         self._samples = 0
+        self._fail_streak = 0
 
     def _is_isolated_locked(self) -> bool:
         return time.monotonic() < self._isolated_until
@@ -72,6 +83,7 @@ class CircuitBreaker:
             self._long_ema = 0.0
             self._short_ema = 0.0
             self._samples = 0
+            self._fail_streak = 0
             self._isolated_until = 0.0
             self._isolation_s = self.base_isolation_s
 

@@ -19,13 +19,10 @@ from brpc_tpu.butil.endpoint import EndPoint
 
 def tcp_probe(ep: EndPoint, timeout: float = 1.0) -> bool:
     if ep.is_tpu():
-        from brpc_tpu.tpu.mesh import resolve_device
-
-        try:
-            resolve_device(ep)
-            return True
-        except ValueError:
-            return False
+        # scheme-dispatch kept for direct callers; a tpu endpoint is never
+        # probed with a raw TCP connect (accepting the bootstrap socket
+        # says nothing about the tunnel handshake)
+        return tpu_probe(ep, timeout)
     try:
         fam, addr = ep.sockaddr()
         with _socket.socket(fam, _socket.SOCK_STREAM) as s:
@@ -34,6 +31,36 @@ def tcp_probe(ep: EndPoint, timeout: float = 1.0) -> bool:
         return True
     except OSError:
         return False
+
+
+def tpu_probe(ep: EndPoint, timeout: float = 1.0) -> bool:
+    """tpu:// probe: a local device endpoint must resolve; a remote tunnel
+    endpoint must hold (or re-establish) a completed TPUC handshake — the
+    same connect_tpu path RPCs take, so a successful probe leaves a live
+    healed tunnel behind and resets the endpoint's reconnect breaker."""
+    if not ep.port:
+        from brpc_tpu.tpu.mesh import resolve_device
+
+        try:
+            resolve_device(ep)
+            return True
+        except ValueError:
+            return False
+    try:
+        from brpc_tpu.tpu.transport import _healer_for, connect_tpu
+
+        if connect_tpu(ep, connect_timeout=timeout).failed:
+            return False
+        # a verified-live tunnel is a full pardon for the reconnect breaker
+        _healer_for((ep.host, ep.port, ep.device_ordinal)).breaker.reset()
+        return True
+    except Exception:
+        return False
+
+
+def probe_for_endpoint(ep: EndPoint) -> Callable[[EndPoint], bool]:
+    """Default probe selection by endpoint scheme."""
+    return tpu_probe if ep.is_tpu() else tcp_probe
 
 
 class HealthChecker:
@@ -54,7 +81,9 @@ class HealthChecker:
             interval_s = _flags.get("health_check_interval_s")
         self._lb = lb
         self._interval = interval_s
-        self._probe = probe or tcp_probe
+        # None: pick per node by scheme (tpu:// nodes get tpu_probe, the
+        # rest tcp_probe) — a mixed cluster must not TCP-probe its tunnels
+        self._probe = probe
         self._guard = recover_guard or ClusterRecoverGuard(
             interval_s=interval_s)
         self._stop = threading.Event()
@@ -76,7 +105,8 @@ class HealthChecker:
         total = len(states)
         recovered = 0
         for ep, st in parked:
-            if not self._probe(ep):
+            probe = self._probe or probe_for_endpoint(ep)
+            if not probe(ep):
                 continue
             if not self._guard.may_recover(len(parked) - recovered, total):
                 break  # rationed: next interval takes the next node

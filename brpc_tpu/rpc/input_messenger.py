@@ -145,6 +145,14 @@ class InputMessenger:
                         break  # mid-body: wait for the next read burst
                     sock.pending_body = None
                     msg = cursor.finish()
+                    if batch_hook is not None:
+                        # end-of-body wakeup: the body's final borrowed
+                        # blocks released at feed time — flush their
+                        # credits now (not at batch end) so a peer sender
+                        # parked on the window wakes immediately
+                        eob = getattr(batch_hook, "cut_body_complete", None)
+                        if eob is not None:
+                            eob()
                     if msg is None:
                         continue  # protocol consumed the body internally
                     msgs = (msg,)

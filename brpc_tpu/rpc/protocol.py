@@ -11,6 +11,7 @@ socket's preferred protocol after the first match.
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Dict, List, Optional, Tuple
 
 from brpc_tpu.butil.iobuf import IOBuf
@@ -125,13 +126,17 @@ class PendingBodyCursor:
 class ParsedMessage:
     """One complete wire message, protocol-tagged."""
 
-    __slots__ = ("protocol", "meta", "body", "socket")
+    __slots__ = ("protocol", "meta", "body", "socket", "arrival")
 
     def __init__(self, protocol: "Protocol", meta, body: IOBuf):
         self.protocol = protocol
         self.meta = meta
         self.body = body
         self.socket = None
+        # parse-time monotonic stamp: server-side deadline enforcement
+        # measures queueing delay from here (the client's clock never
+        # crosses the wire, only its timeout_ms budget does)
+        self.arrival = _time.monotonic()
 
 
 class Protocol:

@@ -14,10 +14,20 @@ from __future__ import annotations
 
 import time
 
+from brpc_tpu import fault as _fault
+from brpc_tpu.metrics.reducer import Adder
 from brpc_tpu.policy import compress as _compress
 from brpc_tpu.proto import rpc_meta_pb2
 from brpc_tpu.rpc import errors
 from brpc_tpu.rpc.controller import Controller
+
+# requests rejected because their client timeout budget was already spent
+# before the handler could run (server-side deadline enforcement)
+g_server_deadline_expired = Adder("g_server_deadline_expired")
+
+_fault.register("rpc.handler.crash",
+                "raise inside the service method (both dispatch paths) — "
+                "must surface as EINTERNAL, never a dead connection")
 
 
 def run_interceptor(server, cntl):
@@ -60,6 +70,23 @@ def process_rpc_request(protocol, msg, server) -> None:
     if not server.add_concurrency():
         return send_error(errors.ELIMIT, "server max_concurrency reached")
     start_us = time.perf_counter_ns() // 1000
+
+    # ---- server-side deadline: timeout_ms rides the RequestMeta but was
+    # never checked here — a request whose client budget is already spent
+    # (queueing, decompress backlog) would compute a response nobody waits
+    # for. Reject before the handler; batch enqueue re-checks deadline_mono.
+    budget_ms = int(meta.request.timeout_ms or 0)
+    if budget_ms > 0:
+        arrival = getattr(msg, "arrival", 0.0)
+        if arrival:
+            if (time.monotonic() - arrival) * 1000.0 >= budget_ms:
+                g_server_deadline_expired.put(1)
+                server.sub_concurrency()
+                return send_error(
+                    errors.ERPCTIMEDOUT,
+                    f"request deadline ({budget_ms}ms) already spent "
+                    f"before dispatch")
+            cntl.deadline_mono = arrival + budget_ms / 1000.0
 
     # ---- admission + lookup; failures settle server concurrency here
     err = None
@@ -167,6 +194,8 @@ def process_rpc_request(protocol, msg, server) -> None:
         # is "current" while it runs so downstream calls stitch the trace
         prev_span = _span.set_current(cntl.span)
         try:
+            if _fault.hit("rpc.handler.crash") is not None:
+                raise RuntimeError("fault injected handler crash")
             ret = entry.fn(cntl, request, done)
         except Exception as e:  # user bug -> EINTERNAL, not a dead connection
             cntl.set_failed(errors.EINTERNAL, f"method raised: {e}")
@@ -211,6 +240,7 @@ class FastServerController:
     http_request = None
     _accepted_stream_id = 0
     stream_id = 0
+    deadline_mono = 0.0  # monotonic deadline (0 = none); batch admit checks
 
     def __init__(self, server, sock, svc, meth, log_id, timeout_ms):
         self.server = server
@@ -349,6 +379,10 @@ def fast_process_request(item) -> None:
 
     cntl = FastServerController(server, sock, svc, meth, log_id, timeout_ms)
     cntl.span = span
+    if timeout_ms > 0:
+        # the engine dispatches EV_REQUEST promptly, so the budget starts
+        # (approximately) now; batch enqueue re-checks this deadline
+        cntl.deadline_mono = time.monotonic() + timeout_ms / 1000.0
     if att_size:
         cntl.request_attachment = body[len(body) - att_size:]
         body = body[:len(body) - att_size]
@@ -364,6 +398,8 @@ def fast_process_request(item) -> None:
             return done()
         prev_span = _span.set_current(span)
         try:
+            if _fault.hit("rpc.handler.crash") is not None:
+                raise RuntimeError("fault injected handler crash")
             ret = entry.fn(cntl, request, done)
         except Exception as e:
             cntl.set_failed(errors.EINTERNAL, f"method raised: {e}")

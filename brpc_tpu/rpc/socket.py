@@ -25,6 +25,7 @@ import time as _time
 from collections import deque
 from typing import Callable, Optional, Set
 
+from brpc_tpu import fault as _fault
 from brpc_tpu.butil.endpoint import EndPoint
 from brpc_tpu.butil.iobuf import IOBuf
 from brpc_tpu.butil.resource_pool import VersionedPool
@@ -38,6 +39,10 @@ _socket_pool: VersionedPool = VersionedPool()
 # global traffic counters (exposed later via /vars)
 g_in_bytes = Adder()
 g_out_bytes = Adder()
+
+_fault.register("socket.write.fail",
+                "fail the socket on the next write(); pending calls get "
+                "EFAILEDSOCKET and the SocketMap redials on next use")
 
 RECV_CHUNK = 256 * 1024
 WRITE_QUEUE_MAX_BYTES = 64 * 1024 * 1024  # EOVERCROWDED beyond this
@@ -145,6 +150,12 @@ class Socket:
         socket dies before the bytes could matter.
         """
         if self.failed:
+            if id_wait is not None:
+                _cid.id_error(id_wait, errors.EFAILEDSOCKET)
+            return errors.EFAILEDSOCKET
+        if _fault.hit("socket.write.fail") is not None:
+            self.set_failed(errors.EFAILEDSOCKET,
+                            "fault injected write failure")
             if id_wait is not None:
                 _cid.id_error(id_wait, errors.EFAILEDSOCKET)
             return errors.EFAILEDSOCKET

@@ -25,8 +25,18 @@ import threading
 from collections import deque
 from typing import Dict, Optional
 
+from brpc_tpu import fault as _fault
 from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.metrics.reducer import Adder
 from brpc_tpu.rpc.socket import Socket
+
+# "single" connections silently replaced after a failure — the SocketMap's
+# self-healing made visible (and assertable from chaos tests)
+g_socketmap_reconnects = Adder("g_socketmap_reconnects")
+
+_fault.register("socketmap.connect.fail",
+                "raise OSError from SocketMap._new_socket, as if the peer "
+                "refused the dial")
 
 
 class SocketMap:
@@ -66,7 +76,10 @@ class SocketMap:
                 sock = self._map.get(key)
                 if sock is not None and not sock.failed:
                     return sock
+                replacing_failed = sock is not None
             sock = self._new_socket(remote, connect_timeout, ssl_options)
+            if replacing_failed:
+                g_socketmap_reconnects.put(1)
             with self._lock:
                 self._map[key] = sock
             return sock
@@ -74,6 +87,8 @@ class SocketMap:
     # ------------------------------------------------------ pooled / short
     def _new_socket(self, remote: EndPoint, connect_timeout: float,
                     ssl_options) -> Socket:
+        if _fault.hit("socketmap.connect.fail") is not None:
+            raise OSError("fault injected connect failure")
         if self._dispatcher is None:
             from brpc_tpu.rpc.event_dispatcher import pick_dispatcher
 

@@ -26,6 +26,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from brpc_tpu import fault as _fault
 from brpc_tpu.butil.endpoint import EndPoint
 from brpc_tpu.butil.iobuf import IOBuf
 from brpc_tpu.butil.resource_pool import VersionedPool
@@ -39,6 +40,10 @@ from brpc_tpu.rpc.protocol import ParsedMessage
 # device-side traffic counters (the /vars view of the "ICI NIC")
 g_tpu_in_bytes = Adder()
 g_tpu_out_bytes = Adder()
+
+_fault.register("tpu.device.crash",
+                "raise inside a registered device method (loopback path); "
+                "the caller sees EINTERNAL, the socket survives")
 
 
 class DeviceMethodRegistry:
@@ -186,8 +191,11 @@ class TpuSocket:
         with self._pending_lock:
             pending = list(self._pending_ids)
             self._pending_ids.clear()
+        from brpc_tpu.tpu.transport import _retriable
+
+        fan = _retriable(code)
         for cid in pending:
-            _cid.id_error(cid, code)
+            _cid.id_error(cid, fan)
 
     def close(self) -> None:
         self.set_failed(errors.EFAILEDSOCKET, "closed locally")
@@ -220,6 +228,8 @@ class TpuSocket:
                         f"{meta.request.method_name}")
         else:
             try:
+                if _fault.hit("tpu.device.crash") is not None:
+                    raise RuntimeError("fault injected device crash")
                 code, resp_payload, att_out = handler(
                     self.device, meta, payload, attachment)
             except Exception as e:
@@ -251,18 +261,22 @@ _sockets: Dict[Tuple[str, int], TpuSocket] = {}
 _sockets_lock = threading.Lock()
 
 
-def get_tpu_socket(ep: EndPoint):
+def get_tpu_socket(ep: EndPoint, connect_timeout: float = 3.0):
     """Shared per-device socket (the SocketMap of the device world).
 
     Routing: ``tpu://host:port/ordinal`` (port set) is a REMOTE device — a
     peer process serving that chip; dial the cross-process tunnel
     (tpu/transport.py). ``tpu://host/ordinal`` (no port) is a local chip of
     this process; calls run as device programs in-process (the loopback
-    fast path, like the reference short-circuiting 127.0.0.1)."""
+    fast path, like the reference short-circuiting 127.0.0.1).
+
+    ``connect_timeout`` bounds a remote (re)dial — callers with a per-call
+    deadline pass the smaller of the two budgets so a dead tunnel fails
+    the call instead of outliving it."""
     if ep.port:
         from brpc_tpu.tpu.transport import connect_tpu
 
-        return connect_tpu(ep)
+        return connect_tpu(ep, connect_timeout=connect_timeout)
     key = (ep.host, ep.device_ordinal)
     with _sockets_lock:
         sock = _sockets.get(key)

@@ -50,6 +50,7 @@ from collections import deque
 from multiprocessing import shared_memory as _shm
 from typing import Dict, List, Optional, Tuple
 
+from brpc_tpu import fault as _fault
 from brpc_tpu.butil.endpoint import EndPoint
 from brpc_tpu.butil.iobuf import IOBuf
 from brpc_tpu.butil.resource_pool import VersionedPool
@@ -74,7 +75,11 @@ FT_DATA = 3       # ordered chunk of the tunnel byte stream
 FT_ACK = 4        # return block credits
 FT_BYE = 5        # orderly shutdown
 
-DATA_BODY_HDR = "!II"         # inline_len, nsegs
+# every stream frame carries the tunnel's window generation (epoch): after
+# a re-handshake rebuilds the pools, DATA/ACK frames still in flight from
+# the previous epoch reference blocks of the torn-down window — the epoch
+# guard discards them instead of mis-crediting the new one
+DATA_BODY_HDR = "!III"        # epoch, inline_len, nsegs
 DATA_BODY_HDR_SIZE = struct.calcsize(DATA_BODY_HDR)
 SEG_FMT = "!II"               # block index, length
 _SEG_SIZE = struct.calcsize(SEG_FMT)
@@ -112,7 +117,7 @@ MAX_SEGS_PER_FRAME = 32       # wire-format cap on segments per DATA frame
 # blocks, and a large message never parks waiting for more credits than
 # one frame needs (the old loop demanded up to MAX_SEGS_PER_FRAME at once)
 SEND_PIPELINE_SEGS = 4
-HANDSHAKE_VERSION = 1
+HANDSHAKE_VERSION = 2  # v2: epoch (window generation) in HELLO/DATA/ACK
 
 # device-fabric traffic counters (the /vars view of the "ICI NIC");
 # named Adders self-expose, so /vars and the Prometheus exporter see them
@@ -127,6 +132,28 @@ g_tunnel_copied_bytes = Adder("g_tunnel_copied_bytes")
 # FT_ACK frames actually written vs credits they carried (batching ratio)
 g_tunnel_ack_frames = Adder("g_tunnel_ack_frames")
 g_tunnel_ack_credits = Adder("g_tunnel_ack_credits")
+# recovery accounting: frames discarded by the epoch guard, tunnels rebuilt
+# by the healer, dial attempts that failed, and end-of-body credit flushes
+g_tunnel_stale_epoch_frames = Adder("g_tunnel_stale_epoch_frames")
+g_tunnel_reconnects = Adder("g_tunnel_reconnects")
+g_tunnel_reconnect_failures = Adder("g_tunnel_reconnect_failures")
+g_tunnel_eob_wakeups = Adder("g_tunnel_eob_wakeups")
+
+# chaos injection points threaded through this module (see fault/core.py
+# and docs/fault-injection.md; zero-cost while disarmed)
+_fault.register("tpu.send.delay", "sleep delay_ms before shipping a packet")
+_fault.register("tpu.tunnel.kill",
+                "fail the bootstrap socket at a DATA frame post "
+                "(the vsock dies mid-message)")
+_fault.register("tpu.frame.drop", "swallow one DATA frame (stream hole)")
+_fault.register("tpu.frame.corrupt",
+                "XOR a byte (params: offset) in a DATA frame")
+_fault.register("tpu.frame.truncate",
+                "cut `bytes` off a DATA frame's tail")
+_fault.register("tpu.ack.drop", "swallow an FT_ACK (peer credits leak)")
+_fault.register("tpu.ack.stall", "sleep delay_ms before writing an FT_ACK")
+_fault.register("tpu.handshake.fail",
+                "server refuses the next HELLO with an error HELLO_ACK")
 
 # high-water mark of blocks lent to the parse path at once (any endpoint in
 # this process): with streaming consume this must sit well below the window
@@ -145,6 +172,15 @@ def _note_borrow_peak(outstanding: int) -> None:
 
 def borrowed_peak_blocks() -> int:
     return _borrow_peak_blocks
+
+
+def reset_borrowed_peak() -> None:
+    """The peak is a monotonic high-water mark; chaos suites reset it
+    between scenarios to assert that recovery re-converges to a bounded
+    borrow footprint (the teardown-leak check)."""
+    global _borrow_peak_blocks
+    with _borrow_peak_lock:
+        _borrow_peak_blocks = 0
 
 
 from brpc_tpu.metrics.status import PassiveStatus as _PassiveStatus  # noqa: E402
@@ -345,6 +381,15 @@ def _pack_frame(ftype: int, body: bytes = b"") -> bytes:
     return struct.pack(CTRL_HDR, CTRL_MAGIC, ftype, len(body)) + body
 
 
+def _retriable(code: int) -> int:
+    """Map a tunnel-death code onto the retryable set: an RPC whose socket
+    died under it did not observably execute, so channel retry /
+    BackupRequestPolicy may re-issue it on the healed tunnel instead of
+    surfacing a terminal error."""
+    return (code if code in errors.DEFAULT_RETRYABLE
+            else errors.EFAILEDSOCKET)
+
+
 class TpuTransportSocket:
     """The virtual socket (reference: 'a Stream IS a fake Socket'). Exposes
     the Socket surface the RPC stack uses — write/pending-ids/set_failed on
@@ -418,8 +463,12 @@ class TpuTransportSocket:
         with self._pending_lock:
             pending = list(self._pending_ids)
             self._pending_ids.clear()
+        # in-flight calls are failed with a RETRIABLE code, never stranded:
+        # the channel's retry policy re-issues them, and _select_socket's
+        # re-dial lands them on the healed tunnel
+        fan = _retriable(code)
         for cid in pending:
-            _cid.id_error(cid, code)
+            _cid.id_error(cid, fan)
         self.endpoint.fail(code, reason, from_vsock=True)
 
     def close(self) -> None:
@@ -440,11 +489,21 @@ class TpuEndpoint:
     def __init__(self, ctrl_sock, role: str, server=None,
                  target_ordinal: int = 0,
                  block_size: int = DEFAULT_BLOCK_SIZE,
-                 block_count: int = DEFAULT_BLOCK_COUNT):
+                 block_count: int = DEFAULT_BLOCK_COUNT,
+                 epoch: int = 0):
         self.ctrl = ctrl_sock
         self.role = role                  # "client" | "server"
         self.server = server              # owning Server (server role)
         self.target_ordinal = target_ordinal
+        # window generation: the dialer proposes it in HELLO, the server
+        # adopts it, every DATA/ACK frame carries it — stale frames from a
+        # torn-down epoch are discarded, not mis-credited
+        self.epoch = epoch
+        # set only after a successful dial registers this endpoint in
+        # _remote_sockets: tunnels that die mid-handshake (or fake-ctrl
+        # test endpoints) never kick the background healer
+        self._heal_enabled = False
+        self._dial_ep: Optional[EndPoint] = None
         if role == "server":
             # window negotiation: the receive pool is created at HELLO
             # time, mirroring the dialer's geometry (reference negotiates
@@ -490,13 +549,15 @@ class TpuEndpoint:
 
     # --------------------------------------------------------------- handshake
     def _hello_body(self, ordinal: int, err: str = "") -> bytes:
+        pool = self.recv_pool
         body = {
             "v": HANDSHAKE_VERSION,
-            "pool": self.recv_pool.name,
-            "bs": self.recv_pool.block_size,
-            "bc": self.recv_pool.block_count,
+            "pool": pool.name if pool is not None else "",
+            "bs": pool.block_size if pool is not None else 0,
+            "bc": pool.block_count if pool is not None else 0,
             "ordinal": ordinal,
             "pid": os.getpid(),
+            "gen": self.epoch,
         }
         if err:
             body["err"] = err
@@ -522,6 +583,28 @@ class TpuEndpoint:
         does not front is refused, not silently served."""
         info = json.loads(body.decode())
         requested = int(info.get("ordinal", 0))
+        gen = int(info.get("gen", 0))
+        f = _fault.hit("tpu.handshake.fail")
+        if f is not None:
+            self.epoch = gen
+            self.ctrl.write(_pack_frame(FT_HELLO_ACK, self._hello_body(
+                requested,
+                err=str(f.get("reason") or "fault injected handshake "
+                                           "refusal"))))
+            self.fail(errors.EREQUEST, "fault injected handshake refusal")
+            return
+        if self.ready.is_set():
+            # repeat HELLO on a live bootstrap: the dialer is rebuilding
+            # its tunnel in place under a higher generation — restart the
+            # stream; a stale/duplicate HELLO from the old epoch is noise
+            if gen <= self.epoch:
+                g_tunnel_stale_epoch_frames.put(1)
+                return
+            self.epoch = gen  # before teardown: old borrows' release
+            # hooks see the epoch mismatch and queue no credits
+            self._restart_epoch()
+        else:
+            self.epoch = gen
         if self.recv_pool is None:
             # mirror the dialer's window geometry for our receive pool
             self.recv_pool = BlockPool(*clamp_geometry(
@@ -546,12 +629,35 @@ class TpuEndpoint:
     def on_hello_ack(self, body: bytes) -> None:
         """Client side: attach the server's pool; tunnel is up."""
         info = json.loads(body.decode())
+        gen = int(info.get("gen", self.epoch))
+        if gen != self.epoch:
+            # an ACK for a handshake this endpoint never sent (old epoch)
+            g_tunnel_stale_epoch_frames.put(1)
+            return
         err = info.get("err")
         if err:
             self.fail(errors.EHOSTDOWN, f"handshake refused: {err}")
             return
         self._attach_peer(info)
         self.ready.set()
+
+    def _restart_epoch(self) -> None:
+        """Server side of an in-band re-handshake: drop this stream's
+        half-parsed state and window attachments so the new HELLO rebuilds
+        them fresh. self.epoch is already the NEW generation, so borrowed
+        views dropped here release without queueing stale credits, and
+        old-epoch frames still in flight bounce off the epoch guard."""
+        with self._ack_lock:
+            self._ack_pending.clear()
+        self.vsock.pending_body = None
+        self.vsock.read_buf.clear()   # releases old borrowed views
+        if self.window is not None:
+            self.window.close()
+            self.window = None
+        if self.recv_pool is not None:
+            self.recv_pool.close()    # deferred while exports remain
+            self.recv_pool = None
+        self.inline_only = False
 
     # -------------------------------------------------------------- send path
     def send_packet(self, packet: IOBuf) -> int:
@@ -564,6 +670,7 @@ class TpuEndpoint:
         rdma_endpoint.h:89 CutFromIOBufList)."""
         if self._failed:
             return errors.EFAILEDSOCKET
+        _fault.maybe_sleep(_fault.hit("tpu.send.delay"))
         views = [memoryview(v) for v in packet.iter_blocks() if len(v)]
         total = sum(len(v) for v in views)
         with self._send_lock:
@@ -586,6 +693,31 @@ class TpuEndpoint:
             # a later packet be parsed against the truncated one
             self.fail(rc, "mid-packet send failure desynced tunnel stream")
         return rc
+
+    def _write_data_frame(self, frame) -> int:
+        """Post one DATA frame on the ctrl socket, applying the armed
+        frame-level faults: kill (the vsock dies exactly as if the
+        bootstrap took an RST mid-message), drop (stream hole), corrupt
+        (bit flip), truncate (short tail)."""
+        if _fault.hit("tpu.tunnel.kill") is not None:
+            self.ctrl.set_failed(errors.EFAILEDSOCKET,
+                                 "fault injected tunnel kill")
+            return errors.EFAILEDSOCKET
+        if _fault.hit("tpu.frame.drop") is not None:
+            return 0  # pretend posted: the peer's byte stream has a hole
+        f = _fault.hit("tpu.frame.corrupt")
+        if f is not None:
+            raw = bytearray(frame.tobytes() if isinstance(frame, IOBuf)
+                            else bytes(frame))
+            pos = min(int(f.get("offset", CTRL_HDR_SIZE)), len(raw) - 1)
+            raw[pos] ^= 0xFF
+            frame = bytes(raw)
+        f = _fault.hit("tpu.frame.truncate")
+        if f is not None:
+            raw = frame.tobytes() if isinstance(frame, IOBuf) \
+                else bytes(frame)
+            frame = raw[:max(0, len(raw) - int(f.get("bytes", 1)))]
+        return self.ctrl.write(frame)
 
     def _send_inline(self, views, total: int):
         """Returns (rc, partial): partial=True once any frame was posted."""
@@ -610,11 +742,11 @@ class TpuEndpoint:
                     voff = 0
             frame = IOBuf()
             frame.append(struct.pack(CTRL_HDR, CTRL_MAGIC, FT_DATA,
-                                     8 + part_len))
-            frame.append(struct.pack(DATA_BODY_HDR, part_len, 0))
+                                     DATA_BODY_HDR_SIZE + part_len))
+            frame.append(struct.pack(DATA_BODY_HDR, self.epoch, part_len, 0))
             for p in parts:
                 frame.append(p)
-            rc = self.ctrl.write(frame)
+            rc = self._write_data_frame(frame)
             if rc != 0:
                 return rc, left != total
             g_tunnel_out_bytes.put(part_len)
@@ -665,9 +797,9 @@ class TpuEndpoint:
                 segs.append((idx, blk_off))
                 if sent >= total:
                     break
-            body = struct.pack(DATA_BODY_HDR, 0, len(segs))
+            body = struct.pack(DATA_BODY_HDR, self.epoch, 0, len(segs))
             body += b"".join(struct.pack(SEG_FMT, i, ln) for i, ln in segs)
-            rc = self.ctrl.write(_pack_frame(FT_DATA, body))
+            rc = self._write_data_frame(_pack_frame(FT_DATA, body))
             if rc != 0:
                 # the frame never entered the peer's byte stream — return
                 # the acquired credits, else they leak forever (the peer
@@ -696,9 +828,15 @@ class TpuEndpoint:
         if len(body) < DATA_BODY_HDR_SIZE:
             self.fail(errors.EREQUEST, "short DATA frame")
             return
-        inline_len, nsegs = struct.unpack(
+        epoch, inline_len, nsegs = struct.unpack(
             DATA_BODY_HDR, body.fetch(DATA_BODY_HDR_SIZE))
         body.pop_front(DATA_BODY_HDR_SIZE)
+        if epoch != self.epoch:
+            # a frame from a previous window generation (in flight across
+            # a re-handshake): its block refs point into the torn-down
+            # pool — discard, never credit
+            g_tunnel_stale_epoch_frames.put(1)
+            return
         if len(body) < inline_len + nsegs * _SEG_SIZE:
             self.fail(errors.EREQUEST, "truncated DATA frame")
             return
@@ -739,7 +877,7 @@ class TpuEndpoint:
                     if vsock.read_buf.append_user_data(
                             view,
                             release=functools.partial(self._credit_released,
-                                                      idx)):
+                                                      idx, pool, epoch)):
                         g_tunnel_borrowed_bytes.put(ln)
                     else:
                         # environment forced a copy; release already ran
@@ -758,19 +896,20 @@ class TpuEndpoint:
         self._messenger.cut_messages(vsock)
 
     # ------------------------------------------------- deferred batched acks
-    def _credit_released(self, idx: int) -> None:
+    def _credit_released(self, idx: int, pool: BlockPool, epoch: int) -> None:
         """Release hook of one borrowed block: runs exactly once, whenever
         the last view over the block dies (parser consumed the bytes, or
-        teardown dropped them)."""
-        pool = self.recv_pool
+        teardown dropped them). The pool and epoch are BOUND at borrow
+        time: after a re-handshake swapped the pools, a late release must
+        drop its export on the OLD pool (letting its deferred close
+        finish) and must NOT queue a credit into the new window."""
         with self._ack_lock:
             self._borrowed_outstanding -= 1
             self._released_total += 1
-            dead = self._failed
+            dead = self._failed or epoch != self.epoch
         if not dead:
             self._queue_acks((idx,))
-        if pool is not None:
-            pool.drop_export()
+        pool.drop_export()
 
     def _queue_acks(self, indices) -> None:
         with self._ack_lock:
@@ -784,7 +923,11 @@ class TpuEndpoint:
     def _write_ack(self, acks: List[int]) -> None:
         if not acks:
             return
-        body = struct.pack(f"!{len(acks) + 1}I", len(acks), *acks)
+        _fault.maybe_sleep(_fault.hit("tpu.ack.stall"))
+        if _fault.hit("tpu.ack.drop") is not None:
+            return  # credits vanish: the peer's window wedges until heal
+        body = struct.pack(f"!{len(acks) + 2}I", self.epoch, len(acks),
+                           *acks)
         g_tunnel_ack_frames.put(1)
         g_tunnel_ack_credits.put(len(acks))
         if self.ctrl.write(_pack_frame(FT_ACK, body)) != 0:
@@ -807,11 +950,32 @@ class TpuEndpoint:
             self._ack_pending = []
         self._write_ack(acks)
 
+    def cut_body_complete(self) -> None:
+        """End-of-body wakeup (the ROADMAP follow-on to streaming parse):
+        a pending-body cursor just finished, which means the cut loop is
+        holding a complete bulk message whose final borrowed blocks were
+        released at feed time — flush the banked credits NOW, bypassing
+        the cut-batch hold, so a peer sender parked on the window wakes
+        immediately instead of waiting for the batch-end ACK."""
+        with self._ack_lock:
+            if self._failed or not self._ack_pending:
+                return
+            acks = self._ack_pending
+            self._ack_pending = []
+        g_tunnel_eob_wakeups.put(1)
+        self._write_ack(acks)
+
     def on_ack(self, body: bytes) -> None:
         vals = struct.unpack(f"!{len(body) // 4}I", body[:len(body) & ~3])
-        n = vals[0] if vals else 0
+        if len(vals) < 2:
+            return
+        epoch, n = vals[0], vals[1]
+        if epoch != self.epoch:
+            # credits for blocks of a torn-down window generation
+            g_tunnel_stale_epoch_frames.put(1)
+            return
         if self.window is not None and n:
-            self.window.release(vals[1:1 + n])
+            self.window.release(vals[2:2 + n])
 
     # ---------------------------------------------------------------- failure
     def fail(self, code: int, reason: str = "", from_vsock: bool = False) -> None:
@@ -843,8 +1007,24 @@ class TpuEndpoint:
         if not self.ctrl.failed:
             self.ctrl.set_failed(code if code else errors.EFAILEDSOCKET,
                                  f"tpu tunnel down: {reason}")
+        # self-heal: a client tunnel that once completed its handshake
+        # re-dials in the background (fresh HELLO, new window generation)
+        # so retried RPCs land on a live socket instead of paying the
+        # dial. Orderly close()/BYE clears _heal_enabled first.
+        heal_ep = self._dial_ep if self._heal_enabled else None
+        if heal_ep is not None:
+            self._heal_enabled = False
+            try:
+                from brpc_tpu import flags as _flags
+
+                if _flags.get("tpu_tunnel_auto_heal"):
+                    _healer_for((heal_ep.host, heal_ep.port,
+                                 heal_ep.device_ordinal)).kick(heal_ep)
+            except Exception:
+                pass
 
     def close(self) -> None:
+        self._heal_enabled = False  # orderly shutdown: nothing to heal
         try:
             self.ctrl.write(_pack_frame(FT_BYE))
         except Exception:
@@ -926,6 +1106,7 @@ class TpuCtrlProtocol(Protocol):
         elif ftype == FT_ACK:
             ep.on_ack(msg.body.tobytes())
         elif ftype == FT_BYE:
+            ep._heal_enabled = False  # peer's shutdown is orderly
             ep.fail(errors.EFAILEDSOCKET, "peer sent BYE")
 
 
@@ -936,42 +1117,182 @@ _remote_sockets: Dict[Tuple[str, int, int], TpuTransportSocket] = {}
 _remote_lock = threading.Lock()
 
 
+class TunnelHandshakeRefused(ConnectionError):
+    """The peer answered HELLO with an error body (wrong ordinal, fault
+    armed): retrying the identical dial cannot succeed, so the healer
+    surfaces it immediately (still feeding the circuit breaker) instead of
+    burning its backoff budget on it."""
+
+
+class TunnelHealer:
+    """Per-(host, port, ordinal) reconnect state: single-dialer election,
+    a monotonically increasing window generation, exponential backoff
+    between attempts, and a circuit breaker so an endpoint that repeatedly
+    fails re-handshake is isolated like any TCP peer (reference
+    circuit_breaker.cpp)."""
+
+    def __init__(self, key: Tuple[str, int, int]):
+        from brpc_tpu.rpc.circuit_breaker import CircuitBreaker
+
+        self.key = key
+        self._cond = threading.Condition()
+        self._dialing = False
+        self._bg_alive = False
+        self._gen = 0
+        # EMA-based tripping needs tens of samples; handshake probes are
+        # rare, so trip on a short consecutive-failure streak instead
+        self.breaker = CircuitBreaker(min_samples=3, fail_streak_trip=3)
+        self.last_error = ""
+
+    def _isolated(self) -> bool:
+        from brpc_tpu import flags as _flags
+
+        return _flags.get("circuit_breaker_enabled") and self.breaker.isolated
+
+    # ------------------------------------------------------------------ dial
+    def connect(self, ep: EndPoint, timeout: float) -> TpuTransportSocket:
+        """Return a healthy vsock for ``ep``, dialing with exponential
+        backoff within ``timeout``. One thread dials at a time; the rest
+        park on the condition and pick up the winner's socket."""
+        from brpc_tpu import flags as _flags
+
+        deadline = _time.monotonic() + timeout
+        backoff = _flags.get("tpu_reconnect_backoff_ms") / 1000.0
+        backoff_max = _flags.get("tpu_reconnect_backoff_max_ms") / 1000.0
+        while True:
+            with _remote_lock:
+                vs = _remote_sockets.get(self.key)
+            if vs is not None and not vs.failed:
+                return vs
+            if self._isolated():
+                raise ConnectionError(
+                    f"tpu endpoint {ep} isolated by circuit breaker "
+                    f"(last error: {self.last_error})")
+            with self._cond:
+                if self._dialing:
+                    left = deadline - _time.monotonic()
+                    if left <= 0:
+                        raise ConnectionError(
+                            f"tpu reconnect to {ep} timed out waiting on "
+                            f"the dialing thread")
+                    self._cond.wait(min(left, 0.2))
+                    continue
+                self._dialing = True
+            try:
+                left = deadline - _time.monotonic()
+                if left <= 0:
+                    raise ConnectionError(f"tpu dial to {ep} timed out")
+                try:
+                    vs = self._dial_once(ep, left)
+                except Exception as e:
+                    self.breaker.on_call_end(errors.EHOSTDOWN)
+                    g_tunnel_reconnect_failures.put(1)
+                    self.last_error = str(e)
+                    left = deadline - _time.monotonic()
+                    if isinstance(e, TunnelHandshakeRefused) \
+                            or left <= backoff:
+                        raise
+                    _time.sleep(min(backoff, left))
+                    backoff = min(backoff * 2, backoff_max)
+                    continue
+                self.breaker.on_call_end(0)
+                return vs
+            finally:
+                with self._cond:
+                    self._dialing = False
+                    self._cond.notify_all()
+
+    def _dial_once(self, ep: EndPoint, timeout: float) -> TpuTransportSocket:
+        from brpc_tpu.rpc.event_dispatcher import global_dispatcher
+        from brpc_tpu.rpc.input_messenger import InputMessenger
+        from brpc_tpu.rpc.protocol import find_protocol
+        from brpc_tpu.rpc.socket import Socket
+
+        with self._cond:
+            self._gen += 1
+            gen = self._gen
+        boot = Socket.connect(EndPoint.from_ip_port(ep.host, ep.port),
+                              global_dispatcher(),
+                              timeout=min(timeout, 3.0))
+        boot.preferred_protocol = find_protocol("tpu_ctrl")
+        endpoint = TpuEndpoint(boot, role="client",
+                               target_ordinal=max(ep.device_ordinal, 0),
+                               epoch=gen)
+        boot._tpu_endpoint = endpoint
+        boot.user_data = endpoint
+        endpoint.vsock.remote = ep
+        endpoint._dial_ep = ep
+        messenger = InputMessenger()
+        boot._on_readable = messenger.make_on_readable(boot)
+        boot.register_read()
+        endpoint.send_hello()
+        if not endpoint.ready.wait(timeout):
+            endpoint.fail(errors.EHOSTDOWN, "tpu handshake timeout")
+            raise ConnectionError(f"tpu handshake with {ep} timed out")
+        if endpoint.vsock.failed:
+            text = endpoint.vsock.error_text
+            if "handshake refused" in text:
+                raise TunnelHandshakeRefused(
+                    f"tpu handshake with {ep} failed: {text}")
+            raise ConnectionError(
+                f"tpu handshake with {ep} failed: {text}")
+        with _remote_lock:
+            cur = _remote_sockets.get(self.key)
+            if cur is not None and not cur.failed:
+                endpoint.close()
+                return cur
+            _remote_sockets[self.key] = endpoint.vsock
+        endpoint._heal_enabled = True
+        if gen > 1:
+            g_tunnel_reconnects.put(1)
+        return endpoint.vsock
+
+    # ------------------------------------------------------- background heal
+    def kick(self, ep: EndPoint) -> None:
+        """Rebuild the tunnel off the RPC path so the next caller finds a
+        live socket instead of paying the dial. At most one background
+        healer per key; it gives up after tpu_reconnect_window_s (the next
+        RPC or health probe re-dials on demand)."""
+        with self._cond:
+            if self._bg_alive:
+                return
+            self._bg_alive = True
+        threading.Thread(
+            target=self._bg_heal, args=(ep,), daemon=True,
+            name=f"tpu-heal-{self.key[0]}:{self.key[1]}").start()
+
+    def _bg_heal(self, ep: EndPoint) -> None:
+        from brpc_tpu import flags as _flags
+
+        try:
+            self.connect(ep, _flags.get("tpu_reconnect_window_s"))
+        except Exception:
+            pass  # bounded give-up; failures already fed the breaker
+        finally:
+            with self._cond:
+                self._bg_alive = False
+
+
+_healers: Dict[Tuple[str, int, int], TunnelHealer] = {}
+
+
+def _healer_for(key: Tuple[str, int, int]) -> TunnelHealer:
+    with _remote_lock:
+        h = _healers.get(key)
+        if h is None:
+            h = _healers[key] = TunnelHealer(key)
+        return h
+
+
 def connect_tpu(ep: EndPoint, connect_timeout: float = 3.0) -> TpuTransportSocket:
     """Dial a remote tpu:// endpoint: TCP bootstrap, HELLO handshake, block
-    pools attached — returns the virtual socket the client stack writes to."""
-    from brpc_tpu.rpc.event_dispatcher import global_dispatcher
-    from brpc_tpu.rpc.protocol import find_protocol
-    from brpc_tpu.rpc.socket import Socket
-
+    pools attached — returns the virtual socket the client stack writes to.
+    A failed cached tunnel is re-dialed through the endpoint's TunnelHealer
+    (single-dialer, exponential backoff, circuit breaker, fresh window
+    generation); a healthy cached tunnel returns immediately."""
     key = (ep.host, ep.port, ep.device_ordinal)
     with _remote_lock:
         vs = _remote_sockets.get(key)
         if vs is not None and not vs.failed:
             return vs
-    from brpc_tpu.rpc.input_messenger import InputMessenger
-
-    boot = Socket.connect(EndPoint.from_ip_port(ep.host, ep.port),
-                          global_dispatcher(), timeout=connect_timeout)
-    boot.preferred_protocol = find_protocol("tpu_ctrl")
-    endpoint = TpuEndpoint(boot, role="client",
-                           target_ordinal=max(ep.device_ordinal, 0))
-    boot._tpu_endpoint = endpoint
-    boot.user_data = endpoint
-    endpoint.vsock.remote = ep
-    messenger = InputMessenger()
-    boot._on_readable = messenger.make_on_readable(boot)
-    boot.register_read()
-    endpoint.send_hello()
-    if not endpoint.ready.wait(connect_timeout):
-        endpoint.fail(errors.EHOSTDOWN, "tpu handshake timeout")
-        raise ConnectionError(f"tpu handshake with {ep} timed out")
-    if endpoint.vsock.failed:
-        raise ConnectionError(
-            f"tpu handshake with {ep} failed: {endpoint.vsock.error_text}")
-    with _remote_lock:
-        cur = _remote_sockets.get(key)
-        if cur is not None and not cur.failed:
-            endpoint.close()
-            return cur
-        _remote_sockets[key] = endpoint.vsock
-        return endpoint.vsock
+    return _healer_for(key).connect(ep, connect_timeout)

@@ -27,3 +27,10 @@ except ImportError:  # non-jax environments still run the pure-RPC tests
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 run")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection tests (in the tier-1 budget)")

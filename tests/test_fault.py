@@ -1,0 +1,531 @@
+"""Chaos suite: the fault-injection framework and the self-healing tunnel.
+
+Three layers, mirroring how the framework is meant to be used:
+
+* registry semantics (arm/disarm, triggers, the master gate) — pure units;
+* each injection point observably fires at its call site — fake-ctrl
+  endpoints and wire-frame assertions;
+* the tunnel survives what the points break — real servers, real shm
+  windows: a vsock killed mid-16MB message heals under a new epoch and the
+  retried call still crosses zero-copy, stale frames of the dead epoch
+  bounce off the guard, and an endpoint that keeps refusing re-handshake
+  is isolated by the healer's circuit breaker.
+"""
+
+import json
+import struct
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import fault
+from brpc_tpu import flags as _flags
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.proto import echo_pb2
+from brpc_tpu.rpc import (
+    Channel,
+    ChannelOptions,
+    Controller,
+    Server,
+    ServerOptions,
+    Stub,
+)
+
+from test_tpu_transport import (  # noqa: F401  (fixture reuse)
+    EchoServiceImpl,
+    _acked_indices,
+    _data_frame_body,
+    _make_endpoint,
+    _stub_for,
+    _trpc_response_packet,
+    tpu_server,
+)
+
+pytestmark = pytest.mark.chaos
+
+ECHO = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+
+@pytest.fixture()
+def fault_enabled():
+    _flags.set_flag("fault_injection_enabled", True)
+    yield
+    fault.disarm_all()
+    _flags.set_flag("fault_injection_enabled", False)
+
+
+# ---------------------------------------------------------------- registry
+class TestRegistry:
+    def test_master_gate_defaults_off(self):
+        fault.arm("x.gated", mode="always")
+        try:
+            assert fault.hit("x.gated") is None
+        finally:
+            fault.disarm("x.gated")
+
+    def test_oneshot_after_n(self, fault_enabled):
+        fault.arm("x.shot", after=2, k=7)
+        assert fault.hit("x.shot") is None
+        assert fault.hit("x.shot") is None
+        fired = fault.hit("x.shot")
+        assert fired == {"k": 7}
+        # oneshot: consumed and auto-disarmed
+        assert fault.hit("x.shot") is None
+        assert not fault.disarm("x.shot")
+
+    def test_always_with_count_and_match(self, fault_enabled):
+        fault.arm("x.many", mode="always", count=2, match={"ftype": 3})
+        # mismatch neither fires nor consumes
+        assert fault.hit("x.many", ftype=4) is None
+        assert fault.hit("x.many", ftype=3) is not None
+        assert fault.hit("x.many", ftype=3) is not None
+        assert fault.hit("x.many", ftype=3) is None  # count exhausted
+
+    def test_parse_spec_kv_coercion(self, fault_enabled):
+        fault.parse_spec_kv("x.kv", {"mode": "always", "after": "1",
+                                     "match_role": "client",
+                                     "delay_ms": "25", "flag": "true"})
+        assert fault.hit("x.kv", role="server") is None
+        assert fault.hit("x.kv", role="client") is None       # after=1 skip
+        fired = fault.hit("x.kv", role="client")
+        assert fired == {"delay_ms": 25, "flag": True}
+        fault.disarm("x.kv")
+
+    def test_snapshot_reports_armed_state(self, fault_enabled):
+        fault.arm("x.snap", mode="always", p=1)
+        try:
+            fault.hit("x.snap")
+            rows = {r["point"]: r for r in fault.snapshot()}
+            row = rows["x.snap"]
+            assert row["fired"] >= 1
+            assert row["armed"]["mode"] == "always"
+            assert row["armed"]["params"] == {"p": 1}
+        finally:
+            fault.disarm("x.snap")
+
+
+# ------------------------------------------------------- points fire (unit)
+class TestInjectionPointsFire:
+    def test_send_delay(self, fault_enabled):
+        tr, fake, ep = _make_endpoint()
+        try:
+            fault.arm("tpu.send.delay", delay_ms=60)
+            t0 = time.monotonic()
+            assert ep.send_packet(IOBuf(b"tiny")) == 0
+            assert time.monotonic() - t0 >= 0.05
+        finally:
+            ep.fail(0, "test done")
+
+    def test_frame_corrupt_flips_a_byte(self, fault_enabled):
+        tr, fake, ep = _make_endpoint()
+        try:
+            assert ep.send_packet(IOBuf(b"payload!")) == 0
+            clean = fake.frames[-1]
+            fault.arm("tpu.frame.corrupt", offset=len(clean) - 1)
+            assert ep.send_packet(IOBuf(b"payload!")) == 0
+            dirty = fake.frames[-1]
+            assert len(dirty) == len(clean)
+            assert dirty[-1] == clean[-1] ^ 0xFF
+            assert dirty[:-1] == clean[:-1]
+        finally:
+            ep.fail(0, "test done")
+
+    def test_frame_truncate_cuts_the_tail(self, fault_enabled):
+        tr, fake, ep = _make_endpoint()
+        try:
+            assert ep.send_packet(IOBuf(b"payload!")) == 0
+            clean = fake.frames[-1]
+            fault.arm("tpu.frame.truncate", bytes=3)
+            assert ep.send_packet(IOBuf(b"payload!")) == 0
+            assert fake.frames[-1] == clean[:-3]
+        finally:
+            ep.fail(0, "test done")
+
+    def test_frame_drop_posts_nothing(self, fault_enabled):
+        tr, fake, ep = _make_endpoint()
+        try:
+            n0 = len(fake.frames)
+            fault.arm("tpu.frame.drop")
+            assert ep.send_packet(IOBuf(b"gone")) == 0    # "posted" ok
+            assert len(fake.frames) == n0                 # ...but no frame
+            assert ep.send_packet(IOBuf(b"kept")) == 0
+            assert len(fake.frames) == n0 + 1
+        finally:
+            ep.fail(0, "test done")
+
+    def test_tunnel_kill_fails_the_vsock(self, fault_enabled):
+        tr, fake, ep = _make_endpoint()
+        fault.arm("tpu.tunnel.kill")
+        assert ep.send_packet(IOBuf(b"boom")) != 0
+        assert fake.failed
+        assert ep.vsock.failed
+
+    def test_ack_drop_swallows_credits(self, fault_enabled):
+        tr, fake, ep = _make_endpoint()
+        try:
+            fault.arm("tpu.ack.drop")
+            ep._queue_acks((1, 2))
+            assert _acked_indices(fake) == []       # credits vanished
+            ep._queue_acks((3,))
+            assert _acked_indices(fake) == [[3]]    # oneshot consumed
+        finally:
+            ep.fail(0, "test done")
+
+
+# --------------------------------------------------------- epoch discipline
+class _RecorderWindow:
+    def __init__(self):
+        self.released = []
+
+    def release(self, indices):
+        self.released.extend(indices)
+
+    def close(self):
+        pass
+
+
+class TestEpochGuards:
+    def test_stale_ack_is_discarded(self):
+        tr, fake, ep = _make_endpoint()
+        try:
+            ep.window = _RecorderWindow()
+            ep.epoch = 3
+            stale0 = tr.g_tunnel_stale_epoch_frames.get_value()
+            ep.on_ack(struct.pack("!4I", 2, 2, 0, 1))     # old epoch
+            assert ep.window.released == []
+            assert tr.g_tunnel_stale_epoch_frames.get_value() == stale0 + 1
+            ep.on_ack(struct.pack("!4I", 3, 2, 0, 1))     # current epoch
+            assert ep.window.released == [0, 1]
+        finally:
+            ep.window = None
+            ep.fail(0, "test done")
+
+    def test_stale_data_is_discarded(self):
+        tr, fake, ep = _make_endpoint()
+        try:
+            ep.epoch = 3
+            stale0 = tr.g_tunnel_stale_epoch_frames.get_value()
+            ep.on_data(IOBuf(_data_frame_body([(0, 64)], epoch=2)))
+            assert len(ep.vsock.read_buf) == 0
+            assert ep._borrowed_outstanding == 0          # nothing borrowed
+            assert tr.g_tunnel_stale_epoch_frames.get_value() == stale0 + 1
+        finally:
+            ep.fail(0, "test done")
+
+    def test_server_in_band_rehandshake(self):
+        from test_tpu_transport import _FakeCtrl
+
+        tr, _, client_ep = _make_endpoint()   # donates a real shm pool
+        fake = _FakeCtrl()
+        srv = tr.TpuEndpoint(fake, role="server")
+        try:
+            pool = client_ep.recv_pool
+            hello = {"v": tr.HANDSHAKE_VERSION, "pool": pool.name,
+                     "bs": pool.block_size, "bc": pool.block_count,
+                     "ordinal": 0, "pid": 1, "gen": 1}
+            srv.on_hello(json.dumps(hello).encode())
+            assert srv.ready.is_set() and srv.epoch == 1
+            first_pool = srv.recv_pool
+            assert first_pool is not None
+
+            # the dialer comes back under generation 2 on the SAME socket
+            hello["gen"] = 2
+            srv.on_hello(json.dumps(hello).encode())
+            assert srv.epoch == 2
+            assert srv.recv_pool is not None
+            assert srv.recv_pool is not first_pool        # rebuilt fresh
+            acks = [f for f in fake.frames
+                    if struct.unpack_from(tr.CTRL_HDR, f)[1]
+                    == tr.FT_HELLO_ACK]
+            assert len(acks) == 2
+            last = json.loads(acks[-1][tr.CTRL_HDR_SIZE:].decode())
+            assert last["gen"] == 2 and "err" not in last
+
+            # a stale duplicate HELLO from the dead epoch is pure noise
+            stale0 = tr.g_tunnel_stale_epoch_frames.get_value()
+            hello["gen"] = 1
+            srv.on_hello(json.dumps(hello).encode())
+            assert srv.epoch == 2
+            assert tr.g_tunnel_stale_epoch_frames.get_value() == stale0 + 1
+        finally:
+            srv.fail(0, "test done")
+            client_ep.fail(0, "test done")
+
+
+# ------------------------------------------------------------- EOB wakeup
+class TestEndOfBodyWakeup:
+    def test_flush_bypasses_cut_batch_hold(self):
+        tr, fake, ep = _make_endpoint()
+        try:
+            ep.cut_batch_begin()
+            ep._queue_acks((4, 5))
+            assert _acked_indices(fake) == []         # banked by the hold
+            eob0 = tr.g_tunnel_eob_wakeups.get_value()
+            ep.cut_body_complete()
+            assert _acked_indices(fake) == [[4, 5]]   # flushed NOW
+            assert tr.g_tunnel_eob_wakeups.get_value() == eob0 + 1
+            ep.cut_batch_end()                        # nothing left to send
+            assert _acked_indices(fake) == [[4, 5]]
+        finally:
+            ep.fail(0, "test done")
+
+
+# ----------------------------------------------------- self-healing tunnel
+class TestSelfHealingTunnel:
+    def test_kill_mid_16mb_message_recovers(self, tpu_server, fault_enabled):
+        from brpc_tpu.tpu import transport as tr
+
+        stub = _stub_for(tpu_server, timeout_ms=60000)
+        payload = b"\xc7" * (16 * 1024 * 1024)
+        # warm the tunnel so the kill hits an established epoch
+        assert stub.Echo(echo_pb2.EchoRequest(message="warm")).message \
+            == "warm"
+        ep = tpu_server.listen_endpoint()
+        key = (ep.host, ep.port, ep.device_ordinal)
+        vs0 = tr._remote_sockets.get(key)
+        assert vs0 is not None and not vs0.failed
+        tr.reset_borrowed_peak()
+        copied0 = tr.g_tunnel_copied_bytes.get_value()
+        reconnects0 = tr.g_tunnel_reconnects.get_value()
+
+        # the 9th DATA frame of the streaming send kills the vsock; the
+        # retried attempt must land on a healed tunnel under a new epoch
+        fault.arm("tpu.tunnel.kill", after=8)
+        r = stub.Echo(echo_pb2.EchoRequest(message="big", payload=payload))
+        assert r.payload == payload
+        assert vs0.failed                              # the kill was real
+        vs1 = tr._remote_sockets.get(key)
+        assert vs1 is not None and vs1 is not vs0 and not vs1.failed
+        assert vs1.endpoint.epoch >= 2                 # fresh generation
+        assert tr.g_tunnel_reconnects.get_value() > reconnects0
+        from brpc_tpu.butil.iobuf import supports_block_ownership
+
+        if supports_block_ownership():
+            # the RETRIED 16MB attempt still crossed zero-copy
+            assert tr.g_tunnel_copied_bytes.get_value() == copied0
+
+        # teardown-leak check: every borrow of both the dead and the live
+        # endpoints drains back to zero once the dust settles
+        endpoints = [vs0.endpoint, vs1.endpoint] \
+            + [e for e in tpu_server._tpu_endpoints]
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if all(e._borrowed_outstanding == 0 for e in endpoints):
+                break
+            time.sleep(0.02)
+        assert all(e._borrowed_outstanding == 0 for e in endpoints)
+
+    def test_handshake_refusals_trip_the_breaker(self, tpu_server,
+                                                 fault_enabled):
+        from brpc_tpu.tpu import transport as tr
+
+        ep = tpu_server.listen_endpoint()
+        key = (ep.host, ep.port, ep.device_ordinal)
+        healer = tr._healer_for(key)
+        healer.breaker.reset()
+        fault.arm("tpu.handshake.fail", mode="always",
+                  reason="chaos says no")
+        try:
+            # no cached socket for this key yet: every dial re-handshakes
+            for _ in range(3):
+                with pytest.raises(ConnectionError):
+                    tr.connect_tpu(ep, connect_timeout=5.0)
+            assert healer.breaker.isolated
+            # the breaker now fails fast, without dialing at all
+            with pytest.raises(ConnectionError, match="circuit breaker"):
+                tr.connect_tpu(ep, connect_timeout=5.0)
+        finally:
+            fault.disarm("tpu.handshake.fail")
+            healer.breaker.reset()
+        # pardoned + disarmed: the same endpoint dials clean
+        vs = tr.connect_tpu(ep, connect_timeout=5.0)
+        assert not vs.failed
+
+    def test_tpu_probe_follows_scheme(self, tpu_server):
+        from brpc_tpu.rpc.health_check import (probe_for_endpoint,
+                                               tcp_probe, tpu_probe)
+
+        ep = tpu_server.listen_endpoint()
+        assert probe_for_endpoint(ep) is tpu_probe
+        assert tpu_probe(ep) is True
+        assert tcp_probe(ep) is True                  # delegates by scheme
+
+
+# --------------------------------------------------------- server deadlines
+class _CaptureSock:
+    remote = "chaos://client"
+
+    def __init__(self):
+        self.written = []
+
+    def write(self, packet, id_wait=None):
+        self.written.append(packet.tobytes()
+                            if hasattr(packet, "tobytes") else bytes(packet))
+        return 0
+
+
+class TestServerDeadline:
+    def _request_meta(self, timeout_ms):
+        from brpc_tpu.proto import rpc_meta_pb2
+
+        meta = rpc_meta_pb2.RpcMeta()
+        meta.correlation_id = 77
+        meta.request.service_name = "EchoService"
+        meta.request.method_name = "Echo"
+        meta.request.timeout_ms = timeout_ms
+        return meta
+
+    def test_expired_budget_rejected_before_handler(self):
+        from brpc_tpu.policy import ensure_registered
+        from brpc_tpu.rpc import errors, server_processing as sp
+        from brpc_tpu.rpc.protocol import ParsedMessage, find_protocol
+
+        ensure_registered()
+        proto = find_protocol("trpc_std")
+        server = Server(ServerOptions())
+        server.add_service(EchoServiceImpl())
+        server.start("127.0.0.1:0")
+        try:
+            msg = ParsedMessage(proto, self._request_meta(100), IOBuf())
+            sock = _CaptureSock()
+            msg.socket = sock
+            msg.arrival = time.monotonic() - 1.0      # budget long gone
+            n0 = sp.g_server_deadline_expired.get_value()
+            sp.process_rpc_request(proto, msg, server)
+            assert sp.g_server_deadline_expired.get_value() == n0 + 1
+            assert len(sock.written) == 1
+            rc, resp = proto.parse(IOBuf(sock.written[0]))
+            assert resp.meta.response.error_code == errors.ERPCTIMEDOUT
+            assert server.concurrency == 0            # settled, not leaked
+        finally:
+            server.stop()
+            server.join(timeout=2)
+
+    def test_fresh_budget_sets_deadline_and_dispatches(self):
+        from brpc_tpu.policy import ensure_registered
+        from brpc_tpu.rpc import errors, server_processing as sp
+        from brpc_tpu.rpc.protocol import ParsedMessage, find_protocol
+        from brpc_tpu.proto import echo_pb2 as _echo
+
+        ensure_registered()
+        proto = find_protocol("trpc_std")
+        server = Server(ServerOptions())
+        server.add_service(EchoServiceImpl())
+        server.start("127.0.0.1:0")
+        try:
+            meta = self._request_meta(30000)
+            req = _echo.EchoRequest(message="hi")
+            msg = ParsedMessage(proto, meta, IOBuf(req.SerializeToString()))
+            sock = _CaptureSock()
+            msg.socket = sock
+            sp.process_rpc_request(proto, msg, server)
+            deadline = time.monotonic() + 2.0
+            while not sock.written and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert sock.written, "handler never answered"
+            rc, resp = proto.parse(IOBuf(sock.written[0]))
+            assert resp.meta.response.error_code == errors.OK
+        finally:
+            server.stop()
+            server.join(timeout=2)
+
+    def test_batch_admit_rejects_spent_deadline(self):
+        from brpc_tpu.batch.runtime import make_batched
+        from brpc_tpu.rpc import errors
+
+        calls = []
+        bound = make_batched("chaos.batch",
+                             lambda ctx: calls.append(ctx) or
+                             [None] * ctx.size)
+        cntl = Controller()
+        cntl.deadline_mono = time.monotonic() - 0.5
+        done_called = []
+        bound(cntl, object(), lambda resp: done_called.append(resp))
+        assert cntl.error_code == errors.ERPCTIMEDOUT
+        assert not calls and not done_called
+
+    def test_handler_crash_point_is_isolated(self, tpu_server,
+                                             fault_enabled):
+        from brpc_tpu.rpc import errors
+        from brpc_tpu.rpc.channel import RpcError
+
+        stub = _stub_for(tpu_server)
+        fault.arm("rpc.handler.crash")
+        with pytest.raises(RpcError) as ei:
+            stub.Echo(echo_pb2.EchoRequest(message="die"))
+        assert ei.value.error_code == errors.EINTERNAL
+        # the crash consumed the oneshot; the server survived it
+        assert stub.Echo(echo_pb2.EchoRequest(message="ok")).message == "ok"
+
+
+# ----------------------------------------------------- /fault + chaos_run
+class TestFaultServiceAndChaosRun:
+    @pytest.fixture()
+    def http_server(self):
+        server = Server(ServerOptions())
+        server.add_service(EchoServiceImpl())
+        server.start("127.0.0.1:0")
+        yield server
+        server.stop()
+        server.join(timeout=2)
+        fault.disarm_all()
+        _flags.set_flag("fault_injection_enabled", False)
+
+    def test_fault_http_surface(self, http_server):
+        from brpc_tpu.policy.http_protocol import http_fetch
+
+        addr = str(http_server.listen_endpoint())
+        resp = http_fetch(addr, "GET", "/fault")
+        assert resp.status == 200
+        state = json.loads(resp.body)
+        assert state["enabled"] is False
+        points = {r["point"] for r in state["points"]}
+        assert "tpu.tunnel.kill" in points
+        assert "rpc.handler.crash" in points
+
+        resp = http_fetch(addr, "GET",
+                          "/fault/arm?point=x.http&mode=always&delay_ms=5")
+        assert resp.status == 200
+        rows = {r["point"]: r for r in fault.snapshot()}
+        assert rows["x.http"]["armed"]["params"] == {"delay_ms": 5}
+        assert http_fetch(addr, "GET",
+                          "/fault/disarm?point=x.http").status == 200
+        assert http_fetch(addr, "GET",
+                          "/fault/disarm?point=x.http").status == 404
+        assert http_fetch(addr, "GET", "/fault/arm").status == 400
+        assert http_fetch(addr, "GET", "/fault/nonsense").status == 404
+
+    def test_chaos_run_scenario_replay(self, http_server, tmp_path):
+        import sys
+
+        sys.path.insert(0, "tools")
+        try:
+            import chaos_run
+        finally:
+            sys.path.pop(0)
+
+        fault.register("x.scenario", "chaos_run e2e target")
+        scenario = {
+            "steps": [
+                {"op": "flag", "name": "fault_injection_enabled",
+                 "value": "true"},
+                {"op": "arm", "point": "x.scenario", "mode": "always",
+                 "delay_ms": 1},
+                {"op": "sleep", "seconds": 0.01},
+                {"op": "expect_fired", "point": "x.scenario", "min": 0},
+            ]
+        }
+        path = tmp_path / "scenario.json"
+        path.write_text(json.dumps(scenario))
+        addr = str(http_server.listen_endpoint())
+        summary = chaos_run.run_scenario(addr, str(path))
+        assert summary["steps"] == 4
+        assert _flags.get("fault_injection_enabled") is True
+        assert fault.hit("x.scenario") == {"delay_ms": 1}   # really armed
+        # and an unmet expectation fails the run
+        scenario["steps"].append({"op": "expect_fired",
+                                  "point": "x.never", "min": 1})
+        path.write_text(json.dumps(scenario))
+        with pytest.raises(chaos_run.ScenarioError):
+            chaos_run.run_scenario(addr, str(path))

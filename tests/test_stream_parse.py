@@ -279,7 +279,7 @@ class TestMidMessageCreditReturn:
             inline = small + small
             import struct
 
-            body = struct.pack(tr.DATA_BODY_HDR, len(inline), 0) + inline
+            body = struct.pack(tr.DATA_BODY_HDR, 0, len(inline), 0) + inline
             ep.on_data(IOBuf(body))
             assert ep.vsock.in_messages == 2
             # stage 2: a large blocked message streams through the SAME
@@ -361,7 +361,8 @@ class TestSendPipelining:
             datas = self._frames_of(tr, fake, tr.FT_DATA)
             seg_lens = []
             for body in datas:
-                inline_len, nsegs = struct.unpack_from(tr.DATA_BODY_HDR, body)
+                epoch, inline_len, nsegs = struct.unpack_from(
+                    tr.DATA_BODY_HDR, body)
                 assert inline_len == 0
                 assert 1 <= nsegs <= tr.SEND_PIPELINE_SEGS
                 for k in range(nsegs):

@@ -218,9 +218,13 @@ class _FakeCtrl:
         return 0
 
     def set_failed(self, code, reason=""):
+        if self.failed:
+            return
         self.failed = True
         self.error_code = code
         self.error_text = reason
+        if self.on_failed_hook is not None:  # real Socket fires this too
+            self.on_failed_hook(code, reason)
 
 
 def _acked_indices(fake):
@@ -235,7 +239,8 @@ def _acked_indices(fake):
         if ftype == tr.FT_ACK:
             body = raw[tr.CTRL_HDR_SIZE:tr.CTRL_HDR_SIZE + blen]
             vals = struct.unpack(f"!{len(body) // 4}I", body)
-            out.append(list(vals[1:1 + vals[0]]))
+            # v2 ACK body: (epoch, count, *indices)
+            out.append(list(vals[2:2 + vals[1]]))
     return out
 
 
@@ -263,13 +268,14 @@ def _trpc_response_packet(payload: bytes) -> bytes:
     return TrpcStdProtocol().pack_response(meta, payload).tobytes()
 
 
-def _data_frame_body(segs):
-    """DATA body referencing pool blocks: [(idx, ln), ...]."""
+def _data_frame_body(segs, epoch=0):
+    """DATA body referencing pool blocks: [(idx, ln), ...]. Fake-ctrl
+    endpoints are built at epoch 0, so the default matches."""
     import struct
 
     from brpc_tpu.tpu import transport as tr
 
-    body = struct.pack(tr.DATA_BODY_HDR, 0, len(segs))
+    body = struct.pack(tr.DATA_BODY_HDR, epoch, 0, len(segs))
     for idx, ln in segs:
         body += struct.pack(tr.SEG_FMT, idx, ln)
     return body

@@ -1,0 +1,105 @@
+"""Replay a chaos scenario against a live server through /fault.
+
+A scenario file is JSON::
+
+    {"steps": [
+        {"op": "flag", "name": "fault_injection_enabled", "value": "true"},
+        {"op": "arm", "point": "tpu.frame.drop", "mode": "oneshot",
+         "after": 2},
+        {"op": "sleep", "seconds": 0.5},
+        {"op": "expect_fired", "point": "tpu.frame.drop", "min": 1},
+        {"op": "disarm", "point": "tpu.frame.drop"},
+        {"op": "disarm_all"}
+    ]}
+
+Every mutation goes through the server's own builtin services (/flags and
+/fault), so a scenario exercises exactly what an operator can do with
+curl — nothing here reaches into the process. ``expect_fired`` reads the
+/fault registry snapshot and fails the run when a point fired fewer times
+than expected, which is what makes a scenario usable as a CI assertion.
+
+Usage::
+
+    python tools/chaos_run.py HOST:PORT SCENARIO.json
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.parse
+
+
+class ScenarioError(RuntimeError):
+    """A step failed: non-2xx from the server or an unmet expectation."""
+
+
+def _fetch(target: str, path: str) -> bytes:
+    from brpc_tpu.policy.http_protocol import http_fetch
+
+    resp = http_fetch(target, "GET", path)
+    if resp.status // 100 != 2:
+        raise ScenarioError(f"GET {path} -> {resp.status}: "
+                            f"{resp.body.decode(errors='replace').strip()}")
+    return resp.body
+
+
+def _fault_state(target: str) -> dict:
+    return json.loads(_fetch(target, "/fault"))
+
+
+def run_scenario(target: str, path: str) -> dict:
+    """Execute every step of the scenario at ``path`` against ``target``
+    (a ``host:port`` string). Returns a summary dict; raises
+    :class:`ScenarioError` on the first failed step."""
+    with open(path) as f:
+        scenario = json.load(f)
+    steps = scenario["steps"] if isinstance(scenario, dict) else scenario
+    executed = []
+    for i, step in enumerate(steps):
+        op = step.get("op", "")
+        if op == "flag":
+            q = urllib.parse.quote(str(step["value"]), safe="")
+            _fetch(target, f"/flags/{step['name']}?setvalue={q}")
+        elif op == "arm":
+            kv = {k: v for k, v in step.items() if k != "op"}
+            _fetch(target, "/fault/arm?" + urllib.parse.urlencode(kv))
+        elif op == "disarm":
+            _fetch(target, "/fault/disarm?"
+                   + urllib.parse.urlencode({"point": step["point"]}))
+        elif op == "disarm_all":
+            _fetch(target, "/fault/disarm_all")
+        elif op == "sleep":
+            time.sleep(float(step.get("seconds", 0.1)))
+        elif op == "expect_fired":
+            want = int(step.get("min", 1))
+            rows = {r["point"]: r for r in _fault_state(target)["points"]}
+            row = rows.get(step["point"])
+            fired = row["fired"] if row else 0
+            if fired < want:
+                raise ScenarioError(
+                    f"step {i}: expected {step['point']} fired >= {want}, "
+                    f"saw {fired}")
+        else:
+            raise ScenarioError(f"step {i}: unknown op {op!r}")
+        executed.append(op)
+    return {"target": target, "steps": len(executed), "ops": executed}
+
+
+def main(argv) -> int:
+    if len(argv) != 3:
+        print(__doc__, file=sys.stderr)
+        return 2
+    try:
+        summary = run_scenario(argv[1], argv[2])
+    except ScenarioError as e:
+        print(f"chaos_run: FAILED: {e}", file=sys.stderr)
+        return 1
+    print(f"chaos_run: OK ({summary['steps']} steps against "
+          f"{summary['target']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

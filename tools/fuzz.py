@@ -125,13 +125,15 @@ def seeds_tpu_ctrl():
 
     from brpc_tpu.tpu import transport as t
 
-    hello = json.dumps({"v": 1, "pool": "brpctpu_x", "bs": 4096, "bc": 4,
-                        "ordinal": 0, "pid": 1}).encode()
+    hello = json.dumps({"v": t.HANDSHAKE_VERSION, "pool": "brpctpu_x",
+                        "bs": 4096, "bc": 4, "ordinal": 0, "pid": 1,
+                        "gen": 1}).encode()
     import struct
 
-    data = struct.pack(t.DATA_BODY_HDR, 5, 1) + b"hi!!!" + \
+    data = struct.pack(t.DATA_BODY_HDR, 0, 5, 1) + b"hi!!!" + \
         struct.pack(t.SEG_FMT, 0, 16)
-    ack = struct.pack("!I", 2) + struct.pack("!I", 0) + struct.pack("!I", 1)
+    # v2 ACK body: (epoch, count, *indices)
+    ack = struct.pack("!4I", 0, 2, 0, 1)
     return [
         t._pack_frame(t.FT_HELLO, hello),
         t._pack_frame(t.FT_HELLO_ACK, hello),
