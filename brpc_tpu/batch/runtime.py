@@ -134,10 +134,21 @@ def run_batch(queue: BatchQueue, items: List[BatchItem], reason: str) -> None:
     now_us = time.monotonic_ns() // 1000
     note = (f"batch: size={ctx.size} bucket={bucket} reason={reason} "
             f"queue={queue.name}")
+    spans = []
     for it in items:
         span = getattr(it.cntl, "span", None)
         if span is not None:
             span.annotate(f"{note} queue_delay={now_us - it.enqueue_us}us")
+            # phase marks ride the full Span API only (controllers under
+            # test may carry duck-typed spans with just annotate())
+            if hasattr(span, "add_phase"):
+                span.add_phase("batch_wait_us",
+                               max(0, now_us - it.enqueue_us))
+                span.event("batch", size=ctx.size, bucket=bucket,
+                           pad=bucket - ctx.size, reason=reason,
+                           queue=queue.name)
+                spans.append(span)
+    t_exec = time.monotonic_ns()
     try:
         responses = queue.vector_fn(ctx)
     except Exception as e:
@@ -154,6 +165,11 @@ def run_batch(queue: BatchQueue, items: List[BatchItem], reason: str) -> None:
         for it in items:
             run_batch(queue, [it], "isolate")
         return
+    # the vectorized call's wall time is every rider's device time: each
+    # item waited for the whole call, so each span carries the full mark
+    exec_us = (time.monotonic_ns() - t_exec) / 1000.0
+    for span in spans:
+        span.add_phase("execute_us", exec_us)
     n_resp = len(responses) if responses is not None else 0
     for i, it in enumerate(items):
         err = ctx._errors.get(i)

@@ -67,6 +67,8 @@ def status_service(server, http: HttpMessage):
                 out.append(
                     f"  {mname}: count={lr.count()} qps={lr.qps():.1f} "
                     f"latency={lr.latency():.0f}us "
+                    f"p50={lr.latency_percentile(0.5):.0f}us "
+                    f"p90={lr.latency_percentile(0.9):.0f}us "
                     f"p99={lr.latency_percentile(0.99):.0f}us "
                     f"max={lr.max_latency():.0f}us "
                     f"concurrency={entry.current_concurrency} "
@@ -283,8 +285,20 @@ def ids_service(server, http: HttpMessage):
 
 # ----------------------------------------------------------------------- rpcz
 def rpcz_service(server, http: HttpMessage):
+    """Recent sampled spans with phase breakdowns.
+
+    GET /rpcz                         newest-first listing
+        ?count=N                      how many rows (default 50)
+        ?method=substr                substring match on service.method
+        ?min_latency_us=N             only slower spans
+        ?error_only=1                 only spans with a non-zero error code
+        ?format=json                  structured export (tools/trace_view.py)
+    GET /rpcz/<trace_id hex>          every span of one trace
+        ?format=json                  whole-trace JSON export
+    """
     from brpc_tpu.trace import span as _span
 
+    as_json = http.query.get("format", "") == "json"
     sub = _sub_path(http)
     if sub:
         try:
@@ -294,12 +308,91 @@ def rpcz_service(server, http: HttpMessage):
         spans = _span.spans_of_trace(trace_id)
         if not spans:
             return 404, CONTENT_TEXT, f"no spans for trace {sub}\n"
+        if as_json:
+            body = json.dumps(_span.trace_to_dict(trace_id), indent=2)
+            return 200, CONTENT_JSON, body + "\n"
         return 200, CONTENT_TEXT, "".join(s.render() for s in spans)
-    recent = _span.recent_spans(int(http.query.get("count", "50")))
+    try:
+        count = int(http.query.get("count", "50"))
+        min_latency_us = float(http.query.get("min_latency_us", "0"))
+    except ValueError:
+        return 400, CONTENT_TEXT, "count/min_latency_us must be numeric\n"
+    recent = _span.recent_spans(
+        count,
+        method=http.query.get("method", ""),
+        min_latency_us=min_latency_us,
+        error_only=http.query.get("error_only", "") in ("1", "true"),
+    )
+    if as_json:
+        body = json.dumps({"spans": [s.to_dict() for s in recent]}, indent=2)
+        return 200, CONTENT_JSON, body + "\n"
     lines = ["time                 trace_id         span      kind  "
              "latency_us  method"]
     for s in recent:
         lines.append(s.render_row())
+    return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------------------------ tpu
+def tpu_service(server, http: HttpMessage):
+    """tpu:// tunnel observability: window occupancy, borrowed-block peak,
+    credit stalls, epochs, and healer/breaker state. ``?format=json`` for
+    the structured snapshot."""
+    try:
+        from brpc_tpu.tpu import transport as _transport
+    except Exception as e:  # pragma: no cover - tpu lane absent
+        return 200, CONTENT_TEXT, f"tpu transport unavailable: {e}\n"
+
+    state = _transport.tunnel_state()
+    state["server_endpoints"] = []
+    if server is not None:
+        for ep in sorted(getattr(server, "_tpu_endpoints", ()),
+                         key=id):
+            try:
+                state["server_endpoints"].append(ep.state_dict())
+            except Exception:  # endpoint torn down mid-snapshot
+                continue
+    if http.query.get("format", "") == "json":
+        return 200, CONTENT_JSON, json.dumps(state, indent=2) + "\n"
+
+    def _ep_lines(title, eps):
+        out = [f"== {title} =="]
+        if not eps:
+            out.append("(none)")
+        for d in eps:
+            key = d.get("key") or f"{d.get('remote', '?')}"
+            out.append(
+                f"{key}  role={d.get('role')} epoch={d.get('epoch')} "
+                f"ready={d.get('ready')} failed={d.get('failed')} "
+                f"inline_only={d.get('inline_only')}")
+            out.append(
+                f"  window: free={d.get('window_free')}/"
+                f"{d.get('window_total')} "
+                f"borrowed_out={d.get('borrowed_outstanding')} "
+                f"acks_pending={d.get('acks_pending')} "
+                f"credits_released={d.get('credits_released_total')}")
+            out.append(
+                f"  credit: stalls={d.get('credit_stalls')} "
+                f"wait_us={d.get('credit_wait_us', 0.0):.0f}")
+            out.append(
+                f"  io: in={d.get('in_bytes')}B/{d.get('in_messages')}msg "
+                f"out={d.get('out_bytes')}B/{d.get('out_messages')}msg")
+        return out
+
+    lines = [f"borrowed_peak_blocks: {state['borrowed_peak_blocks']}", ""]
+    lines += _ep_lines("client endpoints", state["client_endpoints"])
+    lines.append("")
+    lines += _ep_lines("server endpoints", state["server_endpoints"])
+    lines.append("")
+    lines.append("== healers ==")
+    if not state["healers"]:
+        lines.append("(none)")
+    for h in state["healers"]:
+        lines.append(
+            f"{h['key']}  gen={h['gen']} dialing={h['dialing']} "
+            f"bg_healing={h['bg_healing']} "
+            f"breaker_isolated={h['breaker_isolated']} "
+            f"last_error={h['last_error'] or '-'}")
     return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
 
 
@@ -368,7 +461,12 @@ register_builtin("fibers", fibers_service, "fiber runtime workers")
 register_builtin("threads", threads_service, "python thread stacks")
 register_builtin("memory", memory_service, "process memory stats")
 register_builtin("ids", ids_service, "live call ids")
-register_builtin("rpcz", rpcz_service, "recent rpc spans (/rpcz/<trace_id>)")
+register_builtin("rpcz", rpcz_service,
+                 "recent rpc spans (/rpcz/<trace_id>, ?method= "
+                 "?min_latency_us= ?error_only=1 ?format=json)")
+register_builtin("tpu", tpu_service,
+                 "tpu:// tunnel state: windows, credit stalls, epochs, "
+                 "healers")
 register_builtin("logoff", logoff_service, "stop accepting new requests")
 register_builtin("vlog", vlog_service,
                  "verbose-log sites (/vlog?setlevel=module=N)")

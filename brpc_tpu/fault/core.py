@@ -10,6 +10,11 @@ production. A chaos scenario arms a point with a trigger:
 * ``after=N`` — let N matching hits pass untouched before the trigger
   starts firing (e.g. kill the vsock on the 9th DATA frame of a 16MB
   message).
+* ``p=0.01`` — probabilistic: each eligible hit fires with probability p,
+  and a firing additionally draws a grant from the shared metrics
+  Collector budget (collector_max_samples_per_second), so background
+  chaos can never outrun the process-wide sampling cap. Draw outcomes
+  are observable via g_fault_p_skipped / g_fault_budget_denied.
 
 Arming is scriptable three ways: directly from tests (:func:`arm`), over
 HTTP from a running server (the ``/fault`` builtin service), and through
@@ -25,6 +30,7 @@ in the trigger itself (``match_*`` keys on arm).
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -38,6 +44,8 @@ flags.define("fault_injection_enabled", False,
 
 g_fault_hits = Adder("g_fault_hits")
 g_fault_fired = Adder("g_fault_fired")
+g_fault_p_skipped = Adder("g_fault_p_skipped")        # p-draw missed
+g_fault_budget_denied = Adder("g_fault_budget_denied")  # collector said no
 
 _lock = threading.Lock()
 _points: Dict[str, "FaultPoint"] = {}
@@ -47,12 +55,12 @@ _armed = 0  # lock-free fast-path gate: number of points with a live spec
 class FaultSpec:
     """One armed trigger on one point."""
 
-    __slots__ = ("mode", "after", "count", "match", "params",
+    __slots__ = ("mode", "after", "count", "match", "params", "p",
                  "skipped", "fired")
 
     def __init__(self, mode: str = "oneshot", after: int = 0,
                  count: int = 0, match: Optional[Dict[str, Any]] = None,
-                 params: Optional[Dict[str, Any]] = None):
+                 params: Optional[Dict[str, Any]] = None, p: float = 1.0):
         if mode not in ("oneshot", "always"):
             raise ValueError(f"unknown fault mode {mode!r} "
                              f"(expected oneshot|always)")
@@ -62,6 +70,9 @@ class FaultSpec:
         self.count = int(count) if count else (1 if mode == "oneshot" else 0)
         self.match = dict(match or {})
         self.params = dict(params or {})
+        self.p = float(p)
+        if not (0.0 < self.p <= 1.0):
+            raise ValueError(f"fault p={p!r} out of range (0, 1]")
         self.skipped = 0
         self.fired = 0
 
@@ -91,9 +102,10 @@ def register(name: str, doc: str = "") -> None:
 
 
 def arm(name: str, mode: str = "oneshot", after: int = 0, count: int = 0,
-        match: Optional[Dict[str, Any]] = None, **params) -> None:
+        match: Optional[Dict[str, Any]] = None, p: float = 1.0,
+        **params) -> None:
     """Arm ``name``; replaces any previous spec on the point."""
-    spec = FaultSpec(mode, after, count, match, params)
+    spec = FaultSpec(mode, after, count, match, params, p=p)
     global _armed
     with _lock:
         pt = _points.get(name)
@@ -157,6 +169,19 @@ def hit(name: str, **ctx) -> Optional[Dict[str, Any]]:
             pt.spec = None
             _armed -= 1
             return None
+        if spec.p < 1.0:
+            # probabilistic trigger: a missed draw neither fires nor
+            # consumes the count; a won draw must also win a grant from
+            # the shared Collector budget so sustained p-chaos stays under
+            # collector_max_samples_per_second like every other sampler
+            if random.random() >= spec.p:
+                g_fault_p_skipped.put(1)
+                return None
+            from brpc_tpu.metrics.collector import global_collector
+
+            if not global_collector().ask_to_be_sampled():
+                g_fault_budget_denied.put(1)
+                return None
         spec.fired += 1
         pt.fired += 1
         if spec.count and spec.fired >= spec.count:
@@ -192,7 +217,7 @@ def snapshot() -> List[Dict[str, Any]]:
                 s = pt.spec
                 row["armed"] = {"mode": s.mode, "after": s.after,
                                 "count": s.count, "fired": s.fired,
-                                "match": dict(s.match),
+                                "p": s.p, "match": dict(s.match),
                                 "params": dict(s.params)}
             out.append(row)
         return out
@@ -217,17 +242,19 @@ def _coerce(text: str) -> Any:
 
 def parse_spec_kv(name: str, kv: Dict[str, str]) -> None:
     """Arm from a flat string->string mapping (HTTP query / flag entry):
-    reserved keys mode/after/count, ``match_*`` keys become the match
+    reserved keys mode/after/count/p, ``match_*`` keys become the match
     filter, everything else is a param."""
     mode = kv.get("mode", "oneshot")
     after = int(kv.get("after", 0))
     count = int(kv.get("count", 0))
+    p = float(kv.get("p", 1.0))
     match = {k[len("match_"):]: _coerce(v) for k, v in kv.items()
              if k.startswith("match_")}
     params = {k: _coerce(v) for k, v in kv.items()
-              if k not in ("mode", "after", "count", "point")
+              if k not in ("mode", "after", "count", "point", "p")
               and not k.startswith("match_")}
-    arm(name, mode=mode, after=after, count=count, match=match, **params)
+    arm(name, mode=mode, after=after, count=count, match=match, p=p,
+        **params)
 
 
 def _apply_spec_string(text: str) -> bool:

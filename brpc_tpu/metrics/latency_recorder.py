@@ -116,7 +116,11 @@ class LatencyRecorder:
             _V(rec.qps).expose(f"{prefix}_qps"),
             _V(rec.count).expose(f"{prefix}_count"),
             _V(rec.max_latency).expose(f"{prefix}_max_latency"),
+            _V(lambda: rec.latency_percentile(0.5)).expose(f"{prefix}_latency_p50"),
+            _V(lambda: rec.latency_percentile(0.9)).expose(f"{prefix}_latency_p90"),
             _V(lambda: rec.latency_percentile(0.99)).expose(f"{prefix}_latency_p99"),
             _V(lambda: rec.latency_percentile(0.999)).expose(f"{prefix}_latency_p999"),
         ]
+        # the count var is monotonically increasing; the rest are gauges
+        self._vars[2].prometheus_type = "counter"
         return self

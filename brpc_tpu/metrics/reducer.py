@@ -127,6 +127,10 @@ class Reducer(Generic[T]):
 class Adder(Reducer):
     """bvar::Adder — contention-free sum."""
 
+    # adders only ever accumulate on the write paths that use them here,
+    # so the exposition format advertises them as counters, not gauges
+    prometheus_type = "counter"
+
     def __init__(self, name: str = None):
         super().__init__(0, lambda a, b: a + b, lambda a, b: a - b)
         if name:
@@ -150,6 +154,11 @@ class Adder(Reducer):
             def __init__(w, reducer):
                 super().__init__()
                 w._reducer = reducer
+                # the exposition type rides the wrapper into the registry
+                # (prometheus_text reads it off the exposed object)
+                t = getattr(reducer, "prometheus_type", None)
+                if t is not None:
+                    w.prometheus_type = t
 
             def get_value(w):
                 return w._reducer.get_value()

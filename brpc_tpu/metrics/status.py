@@ -54,12 +54,13 @@ def prometheus_text() -> str:
     lines = []
     for name, var in exposed_variables():
         metric = name.replace(".", "_").replace("-", "_")
+        mtype = getattr(var, "prometheus_type", "gauge")
         samples = getattr(var, "prometheus_samples", None)
         if samples is not None:
             rendered = False
             for labels, num in samples():
                 if not rendered:
-                    lines.append(f"# TYPE {metric} gauge")
+                    lines.append(f"# TYPE {metric} {mtype}")
                     rendered = True
                 lbl = ",".join(
                     f'{k}="{_escape_label(v)}"'
@@ -70,6 +71,6 @@ def prometheus_text() -> str:
             num = float(var.describe())
         except (TypeError, ValueError):
             continue  # prometheus only carries numeric samples
-        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"# TYPE {metric} {mtype}")
         lines.append(f"{metric} {num:g}")
     return "\n".join(lines) + ("\n" if lines else "")

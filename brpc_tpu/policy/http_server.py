@@ -227,6 +227,7 @@ def process_http_request(msg, server) -> None:
         _settle(errors.OK)
 
     try:
+        t_parse = time.perf_counter_ns()
         try:
             if as_json:
                 request = json2pb.json_to_pb(http.body, entry.request_class)
@@ -242,10 +243,15 @@ def process_http_request(msg, server) -> None:
         except Exception as e:
             cntl.set_failed(errors.EREQUEST, f"parse request: {e}")
             return done()
+        if cntl.span is not None:
+            cntl.span.request_size = len(http.body)
+            cntl.span.add_phase(
+                "parse_us", (time.perf_counter_ns() - t_parse) / 1000.0)
 
         from brpc_tpu.trace import span as _span
 
         prev_span = _span.set_current(cntl.span)
+        t_exec = time.perf_counter_ns()
         try:
             ret = entry.fn(cntl, request, done)
         except Exception as e:
@@ -253,6 +259,10 @@ def process_http_request(msg, server) -> None:
             ret = None
         finally:
             _span.set_current(prev_span)
+            if cntl.span is not None:
+                cntl.span.add_phase(
+                    "execute_us",
+                    (time.perf_counter_ns() - t_exec) / 1000.0)
         if not responded[0] and (ret is not None or cntl.failed()):
             done(ret)
     except BaseException:

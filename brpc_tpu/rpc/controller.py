@@ -127,6 +127,20 @@ class Controller:
 
     def _issue_rpc(self) -> None:
         """Pick a socket, pack, write. Caller holds the call-id lock."""
+        if self.span is not None:
+            # the span is "current" across dial + write so the transport
+            # (tpu:// credit stalls, healer dials) annotates this attempt
+            from brpc_tpu.trace import span as _span
+
+            prev_span = _span.set_current(self.span)
+            try:
+                self._issue_rpc_inner()
+            finally:
+                _span.set_current(prev_span)
+        else:
+            self._issue_rpc_inner()
+
+    def _issue_rpc_inner(self) -> None:
         cid = self._call_id
         try:
             sock = self._channel._select_socket(self)
@@ -168,9 +182,15 @@ class Controller:
                 meta.stream_settings.stream_id = self.stream_id
                 meta.stream_settings.window_bytes = stream.options.window_bytes
                 meta.stream_settings.need_feedback = True
+        t_ser = time.perf_counter_ns() if self.span is not None else 0
         payload = _compress.compress(
             self._request.SerializeToString(), self.compress_type
         )
+        if self.span is not None:
+            # request marshalling mirrors response parse — stamp it so a
+            # multi-MB request doesn't read as unattributed span time
+            self.span.add_phase(
+                "parse_us", (time.perf_counter_ns() - t_ser) / 1000.0)
         proto = self._channel._protocol
         if hasattr(proto, "issue_request"):
             # connection-scoped protocols (grpc/h2) pack+write themselves:
@@ -179,10 +199,15 @@ class Controller:
                 sock, meta, payload, self.request_attachment,
                 checksum=self._channel.options.enable_checksum, id_wait=cid)
         else:
+            t_pack = time.perf_counter_ns() if self.span is not None else 0
             packet = proto.pack_request(
                 meta, payload, self.request_attachment,
                 checksum=self._channel.options.enable_checksum,
             )
+            if self.span is not None:
+                # packetization is the head of the send pipeline
+                self.span.add_phase(
+                    "send_us", (time.perf_counter_ns() - t_pack) / 1000.0)
             rc = sock.write(packet, id_wait=cid)
         if rc not in (0, errors.EFAILEDSOCKET):
             # overcrowded etc: surface through the error channel
@@ -247,6 +272,7 @@ class Controller:
             return
         if self.span is not None:
             self.span.response_size = len(payload) + len(attachment)
+        t_parse = time.perf_counter_ns()
         try:
             data = _compress.decompress(payload, meta.compress_type)
             if self._response is not None:
@@ -254,6 +280,9 @@ class Controller:
             self.response_attachment = attachment
         except Exception as e:
             self.set_failed(errors.ERESPONSE, f"parse response: {e}")
+        if self.span is not None:
+            self.span.add_phase(
+                "parse_us", (time.perf_counter_ns() - t_parse) / 1000.0)
         if (self.stream_id and not self.failed()
                 and meta.stream_settings.stream_id):
             # the server accepted: bind our stream to this connection,
@@ -416,8 +445,22 @@ def handle_response_message(msg) -> None:
             # the entry (remove returned True) — deliver exactly once
             _cid.id_error(cid, sock.error_code or errors.EFAILEDSOCKET)
         return
+    if cntl.span is not None:
+        # queue_us on a client span: response cut on the wire (stamped by
+        # the parse loop) -> this dispatch
+        arrival = getattr(msg, "arrival", 0.0)
+        if arrival:
+            cntl.span.add_phase(
+                "queue_us", max(0.0, (time.monotonic() - arrival) * 1e6))
+    t_split = time.perf_counter_ns() if cntl.span is not None else 0
     payload, attachment = msg.protocol.split_attachment(msg)
-    if not msg.protocol.verify_checksum(meta, payload):
+    ok = msg.protocol.verify_checksum(meta, payload)
+    if cntl.span is not None:
+        # attachment split + checksum walk the whole body: wire-format
+        # parsing, so it rides the parse mark
+        cntl.span.add_phase(
+            "parse_us", (time.perf_counter_ns() - t_split) / 1000.0)
+    if not ok:
         cntl.set_failed(errors.ERESPONSE, "response checksum mismatch")
         cntl._finish_locked()
         return

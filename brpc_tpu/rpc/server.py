@@ -49,6 +49,8 @@ class Service:
                     fn=impl,
                     request_class=message_factory.GetMessageClass(mdesc.input_type),
                     response_class=message_factory.GetMessageClass(mdesc.output_type),
+                    stats_prefix=_method_stats_prefix(
+                        self.DESCRIPTOR.name, mdesc.name),
                 )
 
     @property
@@ -58,7 +60,9 @@ class Service:
         return type(self).__name__
 
     def add_method(self, name: str, fn, request_class, response_class) -> None:
-        self._methods[name] = MethodEntry(name, fn, request_class, response_class)
+        self._methods[name] = MethodEntry(
+            name, fn, request_class, response_class,
+            stats_prefix=_method_stats_prefix(self.service_name, name))
 
     def find_method(self, name: str) -> Optional["MethodEntry"]:
         return self._methods.get(name)
@@ -82,6 +86,13 @@ class GenericService(Service):
         raise NotImplementedError
 
 
+def _method_stats_prefix(service: str, method: str) -> str:
+    """/vars name stem for one method's LatencyRecorder: non-identifier
+    characters (dots, '*' of GenericService) collapse to '_'."""
+    raw = f"rpc_method_{service}_{method}"
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in raw)
+
+
 @dataclass
 class MethodEntry:
     name: str
@@ -94,6 +105,8 @@ class MethodEntry:
     current_concurrency: int = 0
     max_concurrency: int = 0  # 0 = unlimited (shorthand for a constant limiter)
     limiter: object = None    # policy/limiters.py ConcurrencyLimiter
+    stats_prefix: str = ""    # /vars stem; exposed on first dispatch
+    _stats_exposed: bool = False
     _conc_lock: threading.Lock = field(default_factory=threading.Lock)
 
     def set_limiter(self, spec) -> "MethodEntry":
@@ -134,6 +147,16 @@ class MethodEntry:
         self.latency.record(latency_us)
         if error_code != errors.OK:
             self.errors_count.put(1)
+        if not self._stats_exposed and self.stats_prefix:
+            # lazy /vars registration: only methods that actually serve
+            # traffic pay registry slots, and the p50/p90/p99 gauges show
+            # up on /vars + /brpc_metrics without any user wiring
+            with self._conc_lock:
+                if self._stats_exposed:
+                    return
+                self._stats_exposed = True
+            self.latency.expose(self.stats_prefix)
+            self.errors_count.expose_as(f"{self.stats_prefix}_errors")
 
 
 @dataclass

@@ -29,6 +29,17 @@ _fault.register("rpc.handler.crash",
                 "raise inside the service method (both dispatch paths) — "
                 "must surface as EINTERNAL, never a dead connection")
 
+# phase marks other layers may stamp while user code runs: handler wall
+# time is reported net of these so a span's phases stay additive
+_EXEC_EXCLUDE = ("respond_us", "send_us", "credit_wait_us", "batch_wait_us")
+
+
+def _other_marks(span) -> float:
+    if span is None:
+        return 0.0
+    ph = span.phases
+    return sum(ph.get(k, 0.0) for k in _EXEC_EXCLUDE)
+
 
 def run_interceptor(server, cntl):
     """Global interception hook (reference interceptor.h Accept): returns
@@ -57,6 +68,17 @@ def process_rpc_request(protocol, msg, server) -> None:
     cntl.span = _span.start_server_span(
         meta, meta.request.service_name, meta.request.method_name,
         peer=str(sock.remote))
+    if cntl.span is not None:
+        # queue_us: wire arrival (stamped by the parse loop) -> dispatch.
+        # The span's clock starts at dispatch, so rewind its start to the
+        # arrival instant — the queue wait is part of the request's life
+        # and the phase marks must stay additive within the span window
+        arrival = getattr(msg, "arrival", 0.0)
+        if arrival:
+            q_us = max(0.0, (time.monotonic() - arrival) * 1e6)
+            cntl.span.start_mono_us -= q_us
+            cntl.span.start_us -= q_us
+            cntl.span.add_phase("queue_us", q_us)
 
     def send_error(code: int, text: str = "") -> None:
         if cntl.span is not None:  # rejected requests must reach /rpcz too
@@ -151,6 +173,7 @@ def process_rpc_request(protocol, msg, server) -> None:
         if responded[0]:
             return
         responded[0] = True
+        t_resp = time.perf_counter_ns()
         payload_out = b""
         if response is not None and not cntl.failed():
             payload_out = _compress.compress(
@@ -164,23 +187,46 @@ def process_rpc_request(protocol, msg, server) -> None:
 
             stream_close(accepted)
             accepted = 0
-        _send_response(
-            protocol, sock, meta, cntl.error_code, cntl.error_text(),
-            payload_out, cntl.response_attachment, cntl.compress_type,
-            accepted_stream_id=accepted,
-        )
+        # the span is "current" across the response write so the tunnel's
+        # send pipeline (credit stalls, quanta) annotates THIS request
+        prev = _span.set_current(cntl.span)
+        try:
+            _send_response(
+                protocol, sock, meta, cntl.error_code, cntl.error_text(),
+                payload_out, cntl.response_attachment, cntl.compress_type,
+                accepted_stream_id=accepted,
+            )
+        finally:
+            _span.set_current(prev)
+        if cntl.span is not None:
+            cntl.span.response_size = (len(payload_out)
+                                       + len(cntl.response_attachment or b""))
+            # respond_us excludes transport phases recorded during the
+            # write (send/credit_wait are their own marks)
+            el = (time.perf_counter_ns() - t_resp) / 1000.0
+            ph = cntl.span.phases
+            el -= ph.get("send_us", 0.0) + ph.get("credit_wait_us", 0.0)
+            cntl.span.add_phase("respond_us", max(0.0, el))
         _settle(cntl.error_code)
 
     try:
+        t_split = time.perf_counter_ns() if cntl.span is not None else 0
         payload, attachment = protocol.split_attachment(msg)
         if cntl.span is not None:
             cntl.span.request_size = len(payload) + len(attachment)
         dumper = getattr(server, "rpc_dumper", None)
         if dumper is not None and dumper.ask_to_be_sampled():
             dumper.sample(meta, payload + attachment)
-        if not protocol.verify_checksum(meta, payload):
+        checksum_ok = protocol.verify_checksum(meta, payload)
+        if cntl.span is not None:
+            # attachment split + checksum walk the whole body: wire-format
+            # parsing, so it rides the parse mark
+            cntl.span.add_phase(
+                "parse_us", (time.perf_counter_ns() - t_split) / 1000.0)
+        if not checksum_ok:
             cntl.set_failed(errors.EREQUEST, "request checksum mismatch")
             return done()
+        t_parse = time.perf_counter_ns()
         try:
             data = _compress.decompress(payload, meta.compress_type)
             request = entry.request_class()
@@ -188,11 +234,16 @@ def process_rpc_request(protocol, msg, server) -> None:
         except Exception as e:
             cntl.set_failed(errors.EREQUEST, f"parse request: {e}")
             return done()
+        if cntl.span is not None:
+            cntl.span.add_phase(
+                "parse_us", (time.perf_counter_ns() - t_parse) / 1000.0)
         cntl.request_attachment = attachment
 
         # USER CODE (reference svc->CallMethod, :838-854); the server span
         # is "current" while it runs so downstream calls stitch the trace
         prev_span = _span.set_current(cntl.span)
+        t_exec = time.perf_counter_ns()
+        ex0 = _other_marks(cntl.span)
         try:
             if _fault.hit("rpc.handler.crash") is not None:
                 raise RuntimeError("fault injected handler crash")
@@ -202,6 +253,13 @@ def process_rpc_request(protocol, msg, server) -> None:
             ret = None
         finally:
             _span.set_current(prev_span)
+            if cntl.span is not None:
+                # handler wall time minus marks other layers stamped while
+                # it ran (inline done(), batch flush) — keeps phases additive
+                el = (time.perf_counter_ns() - t_exec) / 1000.0
+                cntl.span.add_phase(
+                    "execute_us",
+                    max(0.0, el - (_other_marks(cntl.span) - ex0)))
         if not responded[0] and (ret is not None or cntl.failed()):
             done(ret)
         # else: user code kept `done` for async completion; stats settle then
@@ -390,13 +448,20 @@ def fast_process_request(item) -> None:
     done = _FastDone(dp, conn, cid, attempt, cntl, entry, server, start_us)
 
     try:
+        t_parse = time.perf_counter_ns() if span is not None else 0
         try:
             request = entry.request_class()
             request.ParseFromString(body)
         except Exception as e:
             cntl.set_failed(errors.EREQUEST, f"parse request: {e}")
             return done()
+        if span is not None:
+            span.request_size = len(body) + att_size
+            span.add_phase(
+                "parse_us", (time.perf_counter_ns() - t_parse) / 1000.0)
         prev_span = _span.set_current(span)
+        t_exec = time.perf_counter_ns() if span is not None else 0
+        ex0 = _other_marks(span)
         try:
             if _fault.hit("rpc.handler.crash") is not None:
                 raise RuntimeError("fault injected handler crash")
@@ -406,6 +471,11 @@ def fast_process_request(item) -> None:
             ret = None
         finally:
             _span.set_current(prev_span)
+            if span is not None:
+                el = (time.perf_counter_ns() - t_exec) / 1000.0
+                span.add_phase(
+                    "execute_us",
+                    max(0.0, el - (_other_marks(span) - ex0)))
         if not done.responded and (ret is not None or cntl.failed()):
             done(ret)
         # else: async completion — stats settle when done runs
@@ -440,6 +510,8 @@ class _FastDone:
             return
         self.responded = True
         cntl = self.cntl
+        span = cntl.span
+        t_resp = time.perf_counter_ns() if span is not None else 0
         payload_out = b""
         ct = cntl.compress_type
         if response is not None and not cntl.failed():
@@ -451,6 +523,11 @@ class _FastDone:
                         payload_out, cntl.response_attachment,
                         _on_flusher_thread(),  # async dones land off-batch
                         compress_type=ct)
+        if span is not None:
+            span.response_size = (len(payload_out)
+                                  + len(cntl.response_attachment or b""))
+            span.add_phase(
+                "respond_us", (time.perf_counter_ns() - t_resp) / 1000.0)
         self.settle(code)
 
     def settle(self, error_code: int) -> None:

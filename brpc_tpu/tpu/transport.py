@@ -64,6 +64,7 @@ from brpc_tpu.rpc.protocol import (
     ParsedMessage,
     Protocol,
 )
+from brpc_tpu.trace import span as _trace
 
 CTRL_MAGIC = b"TPUC"
 CTRL_HDR = "!4sBI"            # magic, frame type, body length
@@ -138,6 +139,14 @@ g_tunnel_stale_epoch_frames = Adder("g_tunnel_stale_epoch_frames")
 g_tunnel_reconnects = Adder("g_tunnel_reconnects")
 g_tunnel_reconnect_failures = Adder("g_tunnel_reconnect_failures")
 g_tunnel_eob_wakeups = Adder("g_tunnel_eob_wakeups")
+# credit flow-control stalls: a send quantum found the peer window empty
+# and parked on acquire (the stall count is the "why was this RPC slow"
+# headline; the wait total divided by it is the mean ACK round-trip under
+# pressure). Both also accumulate per-endpoint for /tpu.
+g_tunnel_credit_stalls = Adder("g_tunnel_credit_stalls")
+g_tunnel_credit_wait_us = Adder("g_tunnel_credit_wait_us")
+# in-band server-side window rebuilds (client re-HELLO on a live bootstrap)
+g_tunnel_epoch_restarts = Adder("g_tunnel_epoch_restarts")
 
 # chaos injection points threaded through this module (see fault/core.py
 # and docs/fault-injection.md; zero-cost while disarmed)
@@ -442,7 +451,9 @@ class TpuTransportSocket:
         if id_wait is not None:
             self.add_pending_id(id_wait)
         self.last_active = _time.monotonic()
-        rc = self.endpoint.send_packet(packet)
+        # the owning RPC's span (parked by the issuing thread): the send
+        # pipeline below annotates credit stalls / quanta onto it
+        rc = self.endpoint.send_packet(packet, span=_trace.current_span())
         if rc == 0:
             self.out_messages += 1
         elif id_wait is not None:
@@ -527,6 +538,10 @@ class TpuEndpoint:
         self._ack_hold = 0                  # >0: a cut batch is open, defer
         self._borrowed_outstanding = 0      # blocks lent to the parse path
         self._released_total = 0            # lifetime releases (diagnostics)
+        # per-endpoint credit-pressure tallies (mutated under _send_lock;
+        # the /tpu builtin reads them racily, which is fine for a gauge)
+        self.credit_stalls = 0
+        self.credit_wait_us = 0.0
         self.vsock = TpuTransportSocket(self)
         # coalesce credit returns across a dispatcher poll batch: the
         # messenger brackets its cut loop with these hooks on both the
@@ -546,6 +561,39 @@ class TpuEndpoint:
             self._messenger = InputMessenger()
         # bootstrap death must tear down the tunnel and error pending RPCs
         ctrl_sock.on_failed_hook = lambda code, reason: self.fail(code, reason)
+
+    # ------------------------------------------------------------- state view
+    def state_dict(self) -> dict:
+        """Racy-but-consistent-enough snapshot for the /tpu builtin: window
+        occupancy, borrow pressure, credit stalls, epoch — everything an
+        operator needs to explain a wedged or slow tunnel."""
+        win = self.window
+        pool = self.recv_pool
+        with self._ack_lock:
+            borrowed = self._borrowed_outstanding
+            acks_pending = len(self._ack_pending)
+            released = self._released_total
+        return {
+            "role": self.role,
+            "remote": str(self.vsock.remote) if self.vsock.remote else "",
+            "epoch": self.epoch,
+            "ready": self.ready.is_set(),
+            "failed": self._failed,
+            "inline_only": self.inline_only,
+            "peer_ordinal": self.peer_ordinal,
+            "window_total": win.block_count if win is not None else 0,
+            "window_free": len(win._free) if win is not None else 0,
+            "borrowed_outstanding": borrowed,
+            "recv_pool_exports": pool.exports if pool is not None else 0,
+            "acks_pending": acks_pending,
+            "credits_released_total": released,
+            "credit_stalls": self.credit_stalls,
+            "credit_wait_us": int(self.credit_wait_us),
+            "in_bytes": self.vsock.in_bytes,
+            "out_bytes": self.vsock.out_bytes,
+            "in_messages": self.vsock.in_messages,
+            "out_messages": self.vsock.out_messages,
+        }
 
     # --------------------------------------------------------------- handshake
     def _hello_body(self, ordinal: int, err: str = "") -> bytes:
@@ -647,6 +695,7 @@ class TpuEndpoint:
         them fresh. self.epoch is already the NEW generation, so borrowed
         views dropped here release without queueing stale credits, and
         old-epoch frames still in flight bounce off the epoch guard."""
+        g_tunnel_epoch_restarts.put(1)
         with self._ack_lock:
             self._ack_pending.clear()
         self.vsock.pending_body = None
@@ -660,19 +709,26 @@ class TpuEndpoint:
         self.inline_only = False
 
     # -------------------------------------------------------------- send path
-    def send_packet(self, packet: IOBuf) -> int:
+    def send_packet(self, packet: IOBuf, span=None) -> int:
         """Ship one RPC packet's bytes through the tunnel. Chunks bigger
         than the window stream through it (credit flow control); the
         receiver reassembles from its read_buf, so frame boundaries are
         invisible to protocols. Bytes are copied ONCE — straight from the
         packet's IOBuf blocks into the peer's registered blocks (the
         reference posts IOBuf blocks to the QP the same way,
-        rdma_endpoint.h:89 CutFromIOBufList)."""
+        rdma_endpoint.h:89 CutFromIOBufList).
+
+        ``span``: the owning RPC's trace span (or None when unsampled) —
+        receives the ``send_us``/``credit_wait_us`` phase marks and
+        credit-stall / send-quantum events."""
         if self._failed:
             return errors.EFAILEDSOCKET
         _fault.maybe_sleep(_fault.hit("tpu.send.delay"))
         views = [memoryview(v) for v in packet.iter_blocks() if len(v)]
         total = sum(len(v) for v in views)
+        if span is not None:
+            t0 = _time.monotonic_ns()
+            cw0 = span.phases.get("credit_wait_us", 0.0)
         with self._send_lock:
             if self._failed:
                 return errors.EFAILEDSOCKET
@@ -680,13 +736,21 @@ class TpuEndpoint:
                 if total <= INLINE_MAX or self.window is None:
                     rc, partial = self._send_inline(views, total)
                 else:
-                    rc, partial = self._send_blocks(views, total)
+                    rc, partial = self._send_blocks(views, total, span)
             except Exception:
                 if self._failed:
                     # fail() released the shm mapping under our feet
                     # (concurrent BYE/teardown) — a clean error, not a crash
                     return errors.EFAILEDSOCKET
                 raise
+        if rc == 0:
+            self.vsock.out_bytes += total
+        if span is not None:
+            # send_us excludes the credit waits accrued inside this packet
+            # so the phase marks stay additive (waits are their own phase)
+            elapsed = (_time.monotonic_ns() - t0) / 1000.0
+            waited = span.phases.get("credit_wait_us", 0.0) - cw0
+            span.add_phase("send_us", max(0.0, elapsed - waited))
         if rc != 0 and partial:
             # frames of this packet already reached the peer's byte stream:
             # the stream is desynced for good — kill the tunnel, never let
@@ -753,7 +817,7 @@ class TpuEndpoint:
             left -= part_len
         return 0, False
 
-    def _send_blocks(self, views, total: int):
+    def _send_blocks(self, views, total: int, span=None):
         """Returns (rc, partial): partial=True once any frame was posted.
 
         Two-stage pipelined loop: acquire EXACTLY the blocks the next frame
@@ -772,7 +836,26 @@ class TpuEndpoint:
             # exact acquire: ceil-divide what is left, capped at the
             # pipelining quantum — every acquired block WILL carry bytes
             need = min(-(-(total - sent) // bs), SEND_PIPELINE_SEGS)
+            # a stall = the window had zero credits when we asked (the
+            # acquire below then parks until the peer's FT_ACK arrives, so
+            # the measured wait IS one credit round-trip under pressure)
+            stalled = not win._free
+            t_acq = _time.monotonic_ns() if (stalled or span is not None) \
+                else 0
             got = win.acquire(need)
+            if stalled or span is not None:
+                wait_us = (_time.monotonic_ns() - t_acq) / 1000.0
+                if span is not None:
+                    span.add_phase("credit_wait_us", wait_us)
+                if stalled:
+                    self.credit_stalls += 1
+                    self.credit_wait_us += wait_us
+                    g_tunnel_credit_stalls.put(1)
+                    g_tunnel_credit_wait_us.put(int(wait_us))
+                    if span is not None:
+                        span.event("credit_stall", wait_us=round(wait_us, 1),
+                                   need=need,
+                                   got=0 if got is None else len(got))
             if got is None:
                 # window wedged or closed
                 return errors.EOVERCROWDED, sent > 0
@@ -806,7 +889,11 @@ class TpuEndpoint:
                 # can't ACK blocks it never saw) and the window wedges
                 win.release([i for i, _ in segs])
                 return rc, sent > sum(ln for _, ln in segs)
-            g_tunnel_out_bytes.put(sum(ln for _, ln in segs))
+            qbytes = sum(ln for _, ln in segs)
+            g_tunnel_out_bytes.put(qbytes)
+            if span is not None:
+                span.event("send_quantum", blocks=len(segs), bytes=qbytes,
+                           sent=sent, total=total)
         return 0, False
 
     # -------------------------------------------------------------- recv path
@@ -1188,6 +1275,10 @@ class TunnelHealer:
                     self.breaker.on_call_end(errors.EHOSTDOWN)
                     g_tunnel_reconnect_failures.put(1)
                     self.last_error = str(e)
+                    sp = _trace.current_span()
+                    if sp is not None:
+                        sp.event("tunnel_dial_failed", target=str(ep),
+                                 gen=self._gen, error=str(e)[:120])
                     left = deadline - _time.monotonic()
                     if isinstance(e, TunnelHandshakeRefused) \
                             or left <= backoff:
@@ -1245,7 +1336,24 @@ class TunnelHealer:
         endpoint._heal_enabled = True
         if gen > 1:
             g_tunnel_reconnects.put(1)
+        sp = _trace.current_span()
+        if sp is not None:
+            # the dial happened on an RPC's critical path (healer-miss):
+            # stamp it so the trace explains the latency spike
+            sp.event("tunnel_dial", target=str(ep), gen=gen,
+                     reconnect=gen > 1)
         return endpoint.vsock
+
+    # ------------------------------------------------------------- state view
+    def state_dict(self) -> dict:
+        with self._cond:
+            return {
+                "gen": self._gen,
+                "dialing": self._dialing,
+                "bg_healing": self._bg_alive,
+                "breaker_isolated": self.breaker.isolated,
+                "last_error": self.last_error,
+            }
 
     # ------------------------------------------------------- background heal
     def kick(self, ep: EndPoint) -> None:
@@ -1282,6 +1390,30 @@ def _healer_for(key: Tuple[str, int, int]) -> TunnelHealer:
         if h is None:
             h = _healers[key] = TunnelHealer(key)
         return h
+
+
+def tunnel_state() -> dict:
+    """Process-wide tunnel snapshot for the /tpu builtin: every cached
+    client endpoint (window occupancy, borrow/credit pressure, epoch) and
+    every healer (generation, dialing/bg state, breaker). Server-side
+    endpoints are appended by the builtin from ``server._tpu_endpoints``."""
+    with _remote_lock:
+        socks = dict(_remote_sockets)
+        healers = dict(_healers)
+    out = {
+        "borrowed_peak_blocks": borrowed_peak_blocks(),
+        "client_endpoints": [],
+        "healers": [],
+    }
+    for (host, port, ordinal), vs in sorted(socks.items()):
+        d = vs.endpoint.state_dict()
+        d["key"] = f"{host}:{port}/{ordinal}"
+        out["client_endpoints"].append(d)
+    for (host, port, ordinal), h in sorted(healers.items()):
+        d = h.state_dict()
+        d["key"] = f"{host}:{port}/{ordinal}"
+        out["healers"].append(d)
+    return out
 
 
 def connect_tpu(ep: EndPoint, connect_timeout: float = 3.0) -> TpuTransportSocket:

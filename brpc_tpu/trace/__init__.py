@@ -9,20 +9,24 @@ requests to files that ``tools/rpc_replay`` re-issues.
 
 from brpc_tpu.trace.span import (
     Span,
+    PHASE_NAMES,
     start_client_span,
     start_server_span,
     recent_spans,
     spans_of_trace,
+    trace_to_dict,
     reset_for_test,
 )
 from brpc_tpu.trace.rpc_dump import RpcDumper, RpcDumpLoader
 
 __all__ = [
     "Span",
+    "PHASE_NAMES",
     "start_client_span",
     "start_server_span",
     "recent_spans",
     "spans_of_trace",
+    "trace_to_dict",
     "reset_for_test",
     "RpcDumper",
     "RpcDumpLoader",

@@ -7,6 +7,16 @@ list of timestamped annotations. Finished spans land in a bounded in-memory
 SpanDB (the reference persists to disk via the bvar Collector; our DB is a
 ring — the /rpcz surface is identical, the storage budget explicit).
 
+Beyond the reference, spans carry a **phase timeline**: typed duration
+marks (:data:`PHASE_NAMES` — queue/parse/credit_wait/send/batch_wait/
+execute/respond) accumulated by the layers a request crosses, plus a
+bounded list of structured **events** (credit stalls, send quanta, healer
+dials, epoch restarts, batch flushes). Durations are measured on the
+monotonic clock (``time.monotonic_ns``); the wall clock is kept only for
+the displayed start timestamp, so NTP skew can't produce negative or
+inflated latencies. ``to_dict``/``trace_to_dict`` export the whole
+timeline as JSON for ``/rpcz?format=json`` and ``tools/trace_view.py``.
+
 Sampling: ``rpcz_sample_ratio`` flag (1.0 = record everything). The
 decision is made once per trace at the root and inherited downstream, so a
 trace is either fully recorded or not at all.
@@ -18,7 +28,7 @@ import random
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from brpc_tpu import flags as _flags
 
@@ -27,12 +37,29 @@ SPAN_DB_CAPACITY = 10000
 KIND_CLIENT = "client"
 KIND_SERVER = "server"
 
+# The typed phase vocabulary. add_phase accepts any name, but
+# only these roll up into the process-wide g_span_phase_* aggregates so a
+# buggy caller can't mint unbounded /vars.
+PHASE_NAMES = ("queue_us", "parse_us", "credit_wait_us", "send_us",
+               "batch_wait_us", "execute_us", "respond_us")
+
+# Hard cap on structured events per span: a 16MB streaming send emits one
+# event per pipeline quantum, which is bounded, but a pathological retry
+# loop isn't — drop past the cap and count the drops.
+MAX_EVENTS_PER_SPAN = 64
+
+
+def _mono_us() -> float:
+    return time.monotonic_ns() / 1000.0
+
 
 class Span:
     __slots__ = ("trace_id", "span_id", "parent_span_id", "kind",
                  "service", "method", "peer", "start_us", "end_us",
+                 "start_mono_us", "end_mono_us",
                  "error_code", "request_size", "response_size",
-                 "annotations", "_ended")
+                 "annotations", "phases", "events", "events_dropped",
+                 "_ended")
 
     def __init__(self, trace_id: int, span_id: int, parent_span_id: int,
                  kind: str, service: str = "", method: str = "",
@@ -44,30 +71,82 @@ class Span:
         self.service = service
         self.method = method
         self.peer = peer
+        # wall clock for display/cross-process alignment only; all
+        # durations come from the monotonic pair below.
         self.start_us = time.time() * 1e6
         self.end_us = 0.0
+        self.start_mono_us = _mono_us()
+        self.end_mono_us = 0.0
         self.error_code = 0
         self.request_size = 0
         self.response_size = 0
-        self.annotations: List = []  # (us, text)
+        self.annotations: List = []  # (offset_us from start, text)
+        self.phases: Dict[str, float] = {}
+        self.events: List = []  # (offset_us from start, name, fields dict)
+        self.events_dropped = 0
         self._ended = False
 
     # ------------------------------------------------------------ lifecycle
     def annotate(self, text: str) -> None:
         """TRACEPRINTF equivalent."""
-        self.annotations.append((time.time() * 1e6, text))
+        self.annotations.append((_mono_us() - self.start_mono_us, text))
+
+    def add_phase(self, name: str, us: float) -> None:
+        """Accumulate ``us`` microseconds into the named phase (a phase
+        may be touched several times — e.g. credit_wait once per send
+        quantum — and the mark is the sum)."""
+        if us < 0.0:
+            us = 0.0
+        self.phases[name] = self.phases.get(name, 0.0) + us
+
+    def event(self, name: str, **fields) -> None:
+        """Record a structured point-in-time event (credit stall, send
+        quantum, healer dial, epoch restart, batch flush...)."""
+        if len(self.events) >= MAX_EVENTS_PER_SPAN:
+            self.events_dropped += 1
+            return
+        self.events.append((_mono_us() - self.start_mono_us, name, fields))
 
     def end(self, error_code: int = 0) -> None:
         if self._ended:
             return
         self._ended = True
         self.end_us = time.time() * 1e6
+        self.end_mono_us = _mono_us()
         self.error_code = error_code
+        _account_phases(self.phases)
         _db_add(self)
 
     @property
     def latency_us(self) -> float:
-        return (self.end_us or time.time() * 1e6) - self.start_us
+        return (self.end_mono_us or _mono_us()) - self.start_mono_us
+
+    # ------------------------------------------------------------ export
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-shaped export (trace -> spans -> phases/events), the unit
+        of ``/rpcz?format=json`` consumed by tools/trace_view.py."""
+        d: Dict[str, Any] = {
+            "trace_id": f"{self.trace_id:016x}",
+            "span_id": f"{self.span_id:016x}",
+            "parent_span_id": f"{self.parent_span_id:016x}",
+            "kind": self.kind,
+            "service": self.service,
+            "method": self.method,
+            "peer": self.peer,
+            "start_us": self.start_us,
+            "latency_us": self.latency_us,
+            "error_code": self.error_code,
+            "request_size": self.request_size,
+            "response_size": self.response_size,
+            "phases": {k: round(v, 1) for k, v in self.phases.items()},
+            "events": [{"offset_us": round(off, 1), "name": name,
+                        **fields} for off, name, fields in self.events],
+            "annotations": [{"offset_us": round(off, 1), "text": text}
+                            for off, text in self.annotations],
+        }
+        if self.events_dropped:
+            d["events_dropped"] = self.events_dropped
+        return d
 
     # ------------------------------------------------------------ rendering
     def render_row(self) -> str:
@@ -85,9 +164,51 @@ class Span:
             out.append(f"    error_code={self.error_code}")
         out.append(f"    request_size={self.request_size} "
                    f"response_size={self.response_size}")
-        for us, text in self.annotations:
-            out.append(f"    +{us - self.start_us:.0f}us  {text}")
+        if self.phases:
+            total = self.latency_us or 1.0
+            parts = []
+            for name in PHASE_NAMES:
+                if name in self.phases:
+                    v = self.phases[name]
+                    parts.append(f"{name[:-3]}={v:.0f}us"
+                                 f"({100.0 * v / total:.0f}%)")
+            for name, v in self.phases.items():
+                if name not in PHASE_NAMES:
+                    parts.append(f"{name}={v:.0f}us")
+            out.append("    phases: " + " ".join(parts))
+        for off, name, fields in self.events:
+            kv = " ".join(f"{k}={v}" for k, v in fields.items())
+            out.append(f"    +{off:.0f}us  [{name}] {kv}".rstrip())
+        if self.events_dropped:
+            out.append(f"    ... {self.events_dropped} events dropped")
+        for off, text in self.annotations:
+            out.append(f"    +{off:.0f}us  {text}")
         return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------- phase aggregation
+# Process-wide per-phase totals, exported on /vars and prometheus_text as
+# g_span_phase_<name> (microsecond counters across all sampled spans).
+_phase_adders: Dict[str, Any] = {}
+_phase_lock = threading.Lock()
+
+
+def _account_phases(phases: Dict[str, float]) -> None:
+    if not phases:
+        return
+    from brpc_tpu.metrics.reducer import Adder
+
+    for name in phases:
+        if name not in PHASE_NAMES:
+            continue
+        adder = _phase_adders.get(name)
+        if adder is None:
+            with _phase_lock:
+                adder = _phase_adders.get(name)
+                if adder is None:
+                    adder = Adder(f"g_span_phase_{name}")
+                    _phase_adders[name] = adder
+        adder.put(int(phases[name]))
 
 
 # -------------------------------------------------------------------- SpanDB
@@ -112,14 +233,38 @@ def _db_add(span: Span) -> None:
         _by_trace.setdefault(span.trace_id, []).append(span)
 
 
-def recent_spans(count: int = 50) -> List[Span]:
+def recent_spans(count: int = 50, method: str = "",
+                 min_latency_us: float = 0.0,
+                 error_only: bool = False) -> List[Span]:
+    """Newest-first finished spans, optionally filtered (the /rpcz query
+    surface): ``method`` is a substring match against service.method,
+    ``min_latency_us`` keeps only slower spans, ``error_only`` keeps only
+    spans with a non-zero error code."""
     with _db_lock:
-        return list(_db)[-count:][::-1]
+        spans = list(_db)
+    out: List[Span] = []
+    for sp in reversed(spans):
+        if method and method not in f"{sp.service}.{sp.method}":
+            continue
+        if min_latency_us and sp.latency_us < min_latency_us:
+            continue
+        if error_only and not sp.error_code:
+            continue
+        out.append(sp)
+        if len(out) >= count:
+            break
+    return out
 
 
 def spans_of_trace(trace_id: int) -> List[Span]:
     with _db_lock:
         return list(_by_trace.get(trace_id, ()))
+
+
+def trace_to_dict(trace_id: int) -> Dict[str, Any]:
+    """Whole-trace JSON export: trace -> spans -> phases/events."""
+    return {"trace_id": f"{trace_id:016x}",
+            "spans": [sp.to_dict() for sp in spans_of_trace(trace_id)]}
 
 
 def reset_for_test() -> None:
@@ -195,5 +340,3 @@ def start_server_span_ids(trace_id: int, parent_span_id: int, service: str,
         return None
     tid = _gen_id()
     return Span(tid, tid, 0, KIND_SERVER, service, method, peer)
-
-

@@ -93,14 +93,15 @@ class TestRegistry:
         fault.disarm("x.kv")
 
     def test_snapshot_reports_armed_state(self, fault_enabled):
-        fault.arm("x.snap", mode="always", p=1)
+        fault.arm("x.snap", mode="always", q=1)
         try:
             fault.hit("x.snap")
             rows = {r["point"]: r for r in fault.snapshot()}
             row = rows["x.snap"]
             assert row["fired"] >= 1
             assert row["armed"]["mode"] == "always"
-            assert row["armed"]["params"] == {"p": 1}
+            assert row["armed"]["p"] == 1.0  # p is a trigger, not a param
+            assert row["armed"]["params"] == {"q": 1}
         finally:
             fault.disarm("x.snap")
 

@@ -21,11 +21,18 @@ than expected, which is what makes a scenario usable as a CI assertion.
 Usage::
 
     python tools/chaos_run.py HOST:PORT SCENARIO.json
+    python tools/chaos_run.py HOST:PORT SCENARIO.json --dump-traces DIR
+
+``--dump-traces`` pulls the server's sampled spans (``/rpcz?format=json``)
+after the scenario finishes — pass/fail alike — and writes them under DIR
+(``traces.json`` plus one ``trace_<id>.json`` per trace), ready for
+``tools/trace_view.py`` to render the chaos run's waterfalls.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 import urllib.parse
@@ -87,18 +94,56 @@ def run_scenario(target: str, path: str) -> dict:
     return {"target": target, "steps": len(executed), "ops": executed}
 
 
+def dump_traces(target: str, out_dir: str) -> int:
+    """Save every sampled span on the server under ``out_dir``: the raw
+    /rpcz export as traces.json and one trace_<id>.json per trace.
+    Returns the number of traces written."""
+    doc = json.loads(_fetch(target, "/rpcz?format=json"))
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "traces.json"), "w") as f:
+        json.dump(doc, f, indent=2)
+    by_trace = {}
+    for span in doc.get("spans", []):
+        by_trace.setdefault(span.get("trace_id", "unknown"),
+                            []).append(span)
+    for tid, spans in by_trace.items():
+        with open(os.path.join(out_dir, f"trace_{tid}.json"), "w") as f:
+            json.dump({"trace_id": tid, "spans": spans}, f, indent=2)
+    return len(by_trace)
+
+
 def main(argv) -> int:
-    if len(argv) != 3:
+    args = list(argv[1:])
+    dump_dir = None
+    if "--dump-traces" in args:
+        i = args.index("--dump-traces")
+        if i + 1 >= len(args):
+            print(__doc__, file=sys.stderr)
+            return 2
+        dump_dir = args[i + 1]
+        del args[i:i + 2]
+    if len(args) != 2:
         print(__doc__, file=sys.stderr)
         return 2
+    target, scenario = args
+    rc = 0
     try:
-        summary = run_scenario(argv[1], argv[2])
+        summary = run_scenario(target, scenario)
     except ScenarioError as e:
         print(f"chaos_run: FAILED: {e}", file=sys.stderr)
-        return 1
-    print(f"chaos_run: OK ({summary['steps']} steps against "
-          f"{summary['target']})")
-    return 0
+        rc = 1
+    if dump_dir is not None:
+        # traces are most valuable on failure — dump regardless of rc
+        try:
+            n = dump_traces(target, dump_dir)
+            print(f"chaos_run: dumped {n} traces to {dump_dir}")
+        except (ScenarioError, OSError, ValueError) as e:
+            print(f"chaos_run: trace dump failed: {e}", file=sys.stderr)
+            rc = rc or 1
+    if rc == 0:
+        print(f"chaos_run: OK ({summary['steps']} steps against "
+              f"{summary['target']})")
+    return rc
 
 
 if __name__ == "__main__":
