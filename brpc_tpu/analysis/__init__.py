@@ -1,0 +1,26 @@
+"""Static analysis + opt-in runtime checking for brpc_tpu's invariants.
+
+The framework's correctness story rests on a handful of conventions that
+no unit test can pin down exhaustively: poller callbacks never block,
+every acquired block credit reaches a release on all paths, phase marks
+ride the monotonic clock, lock nesting stays acyclic, jax version shims
+are the only modules touching version-fragile APIs, and every metric/flag
+is registered exactly once. ``tpulint`` (tools/tpulint.py) enforces those
+mechanically over the AST; :mod:`runtime_check` validates at runtime what
+static analysis can't (actual lock acquisition order, actual credit
+balance), opt-in via ``BRPC_TPU_CHECK=1``.
+
+This package is intentionally dependency-free (stdlib only): the linter
+must be runnable in CI images without jax, and :func:`poller_context`
+must be importable from hot modules without dragging analysis machinery
+into their import time.
+"""
+
+from brpc_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    LintResult,
+    format_findings,
+    list_rules,
+    run_lint,
+)
+from brpc_tpu.analysis.markers import poller_context  # noqa: F401

@@ -1,0 +1,506 @@
+"""The tpulint rule set — one AST pass per framework invariant.
+
+Every rule documents WHY the invariant exists (which PR's correctness
+story it protects) so a suppression comment has something concrete to
+argue against. Scopes are path-suffix based (see core.in_scope) so the
+rules fire identically whether the lint root is the repo or the package.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from brpc_tpu.analysis.core import (
+    Finding,
+    Package,
+    attr_chain,
+    const_str,
+    has_marker,
+    in_scope,
+    iter_functions,
+    register_rule,
+)
+
+# --------------------------------------------------------------------------
+# Rule 1: no-blocking-in-poller
+# --------------------------------------------------------------------------
+# The EventDispatcher loops and the InputMessenger cut loop are the brpc
+# "never block the event loop" discipline (PAPER.md: one blocked poller
+# stalls every socket it owns). Scope: these modules wholesale, plus any
+# function marked @poller_context (the native packed-batch poller, the
+# tunnel's inline on_data/ACK path).
+
+POLLER_MODULES = {"rpc/event_dispatcher.py", "rpc/input_messenger.py"}
+
+_TIMED_KWARGS = {"timeout", "block", "blocking"}
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    if call.args:
+        return True  # positional timeout (cond.wait(left), acquire(True, 5))
+    return any(kw.arg in _TIMED_KWARGS for kw in call.keywords)
+
+
+def _blocking_call(call: ast.Call) -> Optional[str]:
+    """Message when this call can block a poller thread, else None."""
+    name = attr_chain(call.func)
+    if name is None:
+        return None
+    last = name.split(".")[-1]
+    if "sleep" in last:
+        return f"{name}() sleeps on a poller thread"
+    if last == "acquire" and not _has_timeout(call):
+        return (f"untimed {name}() on a poller thread — pass a timeout or "
+                f"restructure to a try-lock")
+    if last == "wait" and not _has_timeout(call):
+        return f"untimed {name}() parks a poller thread indefinitely"
+    if last == "accept":
+        return f"{name}() blocks on a poller thread"
+    if name == "select.select":
+        return "select.select() blocks on a poller thread"
+    if last in ("get", "put") and not _has_timeout(call):
+        recv = attr_chain(call.func.value) if isinstance(call.func,
+                                                         ast.Attribute) else None
+        if recv is not None and "queue" in recv.lower():
+            return f"blocking queue op {name}() on a poller thread"
+    return None
+
+
+@register_rule(
+    "no-blocking-in-poller",
+    "no sleeps/untimed waits/blocking socket-queue ops on dispatcher, "
+    "cut-loop, or @poller_context code")
+def rule_no_blocking_in_poller(pkg: Package) -> List[Finding]:
+    out: List[Finding] = []
+
+    def scan(body_nodes, rel):
+        for node in body_nodes:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    msg = _blocking_call(sub)
+                    if msg is not None:
+                        out.append(Finding("no-blocking-in-poller", rel,
+                                           sub.lineno, msg))
+
+    for sf in pkg.files:
+        if in_scope(sf.rel, POLLER_MODULES):
+            scan(sf.tree.body, sf.rel)
+        else:
+            for func, _cls in iter_functions(sf.tree):
+                if has_marker(func, "poller_context"):
+                    scan(func.body, sf.rel)
+    return out
+
+
+# --------------------------------------------------------------------------
+# Rule 2: acquire-release pairing
+# --------------------------------------------------------------------------
+# The zero-copy receive/send paths (PR 1/3) hand out owned resources —
+# window credits (PeerWindow.acquire) and block borrows (BlockPool
+# .add_export) — that MUST return exactly once even when the code between
+# acquire and release raises (a leaked credit wedges the peer's window
+# forever; a leaked export blocks pool unmap). A function that acquires
+# must either release inside a try/finally-or-except, or register a
+# release hook (a ``release=`` callback owns the resource from then on).
+
+PAIR_SCOPE = {"tpu/transport.py", "butil/iobuf.py"}
+PAIRS: Dict[str, Set[str]] = {
+    "acquire": {"release"},
+    "add_export": {"drop_export"},
+}
+
+
+@register_rule(
+    "acquire-release",
+    "block/credit acquires in transport + iobuf must reach a release on "
+    "all paths (try/finally, except, or a release= hook)")
+def rule_acquire_release(pkg: Package) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in pkg.files:
+        if not in_scope(sf.rel, PAIR_SCOPE):
+            continue
+        for func, _cls in iter_functions(sf.tree):
+            acquires: List[Tuple[str, ast.Call]] = []
+            protected_releases: Set[str] = set()
+            has_release_hook = False
+            cleanup_zones: List = []
+            for node in ast.walk(func):
+                if isinstance(node, ast.Try):
+                    cleanup_zones.extend(node.finalbody)
+                    for handler in node.handlers:
+                        cleanup_zones.extend(handler.body)
+            for zone in cleanup_zones:
+                for sub in ast.walk(zone):
+                    if isinstance(sub, ast.Call):
+                        name = attr_chain(sub.func)
+                        if name is not None:
+                            protected_releases.add(name.split(".")[-1])
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = attr_chain(node.func)
+                if name is None:
+                    continue
+                last = name.split(".")[-1]
+                if last in PAIRS and not name.startswith("self."):
+                    # self.add_export() inside BlockPool is the definition's
+                    # own bookkeeping, not a borrow by a client
+                    acquires.append((name, node))
+                if last in PAIRS and name.startswith("self."):
+                    acquires.append((name, node))
+                if any(kw.arg == "release" for kw in node.keywords):
+                    has_release_hook = True
+            for name, call in acquires:
+                last = name.split(".")[-1]
+                if func.name == last:
+                    continue  # a wrapper forwarding ownership to its caller
+                releases = PAIRS[last]
+                if releases & protected_releases:
+                    continue
+                if has_release_hook:
+                    continue
+                out.append(Finding(
+                    "acquire-release", sf.rel, call.lineno,
+                    f"{name}(...) has no matching "
+                    f"{'/'.join(sorted(releases))} on the exception path — "
+                    f"wrap the span in try/finally (or except+re-raise), or "
+                    f"register a release= hook"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# Rule 3: monotonic-clock discipline
+# --------------------------------------------------------------------------
+# Phase timelines (PR 5) are additive duration marks: one time.time()
+# stamp in a duration pair lets NTP skew mint negative or inflated
+# latencies silently. Everything on the trace/transport/dispatch paths
+# measures with time.monotonic()/monotonic_ns(); wall clock is allowed
+# only where explicitly suppressed (display timestamps).
+
+MONO_MODULES = {"tpu/transport.py", "rpc/input_messenger.py",
+                "rpc/event_dispatcher.py", "rpc/native_transport.py",
+                "rpc/server_processing.py"}
+MONO_PREFIXES = ("trace/",)
+
+
+@register_rule(
+    "monotonic-clock",
+    "no time.time() in trace/, transport, or the dispatch paths that "
+    "stamp phase marks")
+def rule_monotonic_clock(pkg: Package) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in pkg.files:
+        if not in_scope(sf.rel, MONO_MODULES, MONO_PREFIXES):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                name = attr_chain(node.func)
+                if name in ("time.time", "_time.time"):
+                    out.append(Finding(
+                        "monotonic-clock", sf.rel, node.lineno,
+                        "time.time() on a timed path — durations must use "
+                        "the monotonic clock (wall clock is display-only "
+                        "and needs an explicit suppression)"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# Rule 4: lock-order acyclicity
+# --------------------------------------------------------------------------
+# Build the static lock-nesting graph over rpc/ + tpu/: an edge A->B for
+# every ``with A: ... with B:`` lexical nesting, plus one level of
+# propagation through same-class method calls made while A is held. A
+# cycle is a potential deadlock between two threads taking the locks in
+# opposite orders. Lock-like names: self/module attributes containing
+# "lock" or "cond".
+
+LOCK_SCOPE_PREFIXES = ("rpc/", "tpu/")
+
+
+def _lock_name(expr, cls: Optional[str], rel: str) -> Optional[str]:
+    name = attr_chain(expr)
+    if name is None:
+        return None
+    base = name.split(".")[-1]
+    if "lock" not in base.lower() and "cond" not in base.lower():
+        return None
+    if name.startswith("self."):
+        return f"{cls or '?'}.{base}"
+    if "." not in name:
+        return f"{rel}:{name}"
+    return None  # foreign receiver (win._cond): ambiguous, skip
+
+
+@register_rule(
+    "lock-order",
+    "the static lock-nesting graph across rpc/ + tpu/ must be acyclic")
+def rule_lock_order(pkg: Package) -> List[Finding]:
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    # (class, method) -> locks acquired anywhere in that method's body
+    method_locks: Dict[Tuple[str, str], Set[str]] = {}
+    deferred: List[Tuple[str, str, str, str, int]] = []  # held, cls, meth, rel, line
+
+    def visit(nodes, held: List[str], cls, rel):
+        for child in nodes:
+            if isinstance(child, ast.With):
+                acquired = []
+                for item in child.items:
+                    ln = _lock_name(item.context_expr, cls, rel)
+                    if ln is not None:
+                        for h in held:
+                            edges.setdefault((h, ln), (rel, child.lineno))
+                        acquired.append(ln)
+                visit(child.body, held + acquired, cls, rel)
+                continue
+            if isinstance(child, ast.Call) and held:
+                name = attr_chain(child.func)
+                if name is not None and name.startswith("self.") \
+                        and name.count(".") == 1 and cls is not None:
+                    for h in held:
+                        deferred.append((h, cls, name.split(".")[1],
+                                         rel, child.lineno))
+            visit(list(ast.iter_child_nodes(child)), held, cls, rel)
+
+    for sf in pkg.files:
+        if not in_scope(sf.rel, prefixes=LOCK_SCOPE_PREFIXES):
+            continue
+        for func, cls in iter_functions(sf.tree):
+            if cls is not None:
+                locks = method_locks.setdefault((cls, func.name), set())
+                for node in ast.walk(func):
+                    if isinstance(node, ast.With):
+                        for item in node.items:
+                            ln = _lock_name(item.context_expr, cls, sf.rel)
+                            if ln is not None:
+                                locks.add(ln)
+            visit(func.body, [], cls, sf.rel)
+
+    for held, cls, meth, rel, line in deferred:
+        for ln in method_locks.get((cls, meth), ()):
+            if ln != held:
+                edges.setdefault((held, ln), (rel, line))
+
+    # cycle detection (iterative DFS with colors)
+    adj: Dict[str, List[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+    out: List[Finding] = []
+    color: Dict[str, int] = {}
+    stack_path: List[str] = []
+    reported: Set[frozenset] = set()
+
+    def dfs(n: str):
+        color[n] = 1
+        stack_path.append(n)
+        for m in adj.get(n, ()):
+            if color.get(m, 0) == 0:
+                dfs(m)
+            elif color.get(m) == 1:
+                cyc = stack_path[stack_path.index(m):] + [m]
+                key = frozenset(cyc)
+                if key not in reported:
+                    reported.add(key)
+                    rel, line = edges[(n, m)]
+                    out.append(Finding(
+                        "lock-order", rel, line,
+                        "lock-order cycle: " + " -> ".join(cyc) +
+                        " (two threads taking these in opposite order "
+                        "deadlock)"))
+        stack_path.pop()
+        color[n] = 2
+
+    for n in list(adj):
+        if color.get(n, 0) == 0:
+            dfs(n)
+    return out
+
+
+# --------------------------------------------------------------------------
+# Rule 5: version-guard integrity
+# --------------------------------------------------------------------------
+# jax here is 0.4.x: shard_map lives in jax.experimental.shard_map and
+# takes check_rep (not check_vma); lax.pvary/pcast and
+# ShapeDtypeStruct(vma=...) don't exist. ROADMAP names the shim modules
+# that carry the import fallbacks + kwarg shims; everything else must go
+# through them or a newer jax silently breaks the 0.4.x floor (and vice
+# versa).
+
+SHIM_MODULES = {"tpu/collective.py", "tpu/ring.py", "tpu/pallas_ops.py"}
+
+
+@register_rule(
+    "version-guard",
+    "version-fragile jax APIs (shard_map import, check_vma/vma kwargs, "
+    "lax.pvary/pcast) only inside the ROADMAP shim modules")
+def rule_version_guard(pkg: Package) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in pkg.files:
+        if in_scope(sf.rel, SHIM_MODULES):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if "jax.experimental.shard_map" in alias.name:
+                        out.append(Finding(
+                            "version-guard", sf.rel, node.lineno,
+                            "direct jax.experimental.shard_map import — "
+                            "route through the tpu/collective.py shim"))
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if "jax.experimental.shard_map" in mod or (
+                        mod == "jax" and any(a.name == "shard_map"
+                                             for a in node.names)):
+                    out.append(Finding(
+                        "version-guard", sf.rel, node.lineno,
+                        "direct shard_map import — route through the "
+                        "tpu/collective.py shim"))
+            elif isinstance(node, ast.Call):
+                fname = attr_chain(node.func) or ""
+                for kw in node.keywords:
+                    if kw.arg == "check_vma":
+                        out.append(Finding(
+                            "version-guard", sf.rel, node.lineno,
+                            "check_vma= does not exist on jax 0.4.x "
+                            "(shim maps it to check_rep)"))
+                    elif kw.arg == "vma" and fname.endswith("ShapeDtypeStruct"):
+                        out.append(Finding(
+                            "version-guard", sf.rel, node.lineno,
+                            "ShapeDtypeStruct(vma=...) does not exist on "
+                            "jax 0.4.x — use the pallas_ops._sds helper"))
+            elif isinstance(node, ast.Attribute):
+                if node.attr in ("pvary", "pcast"):
+                    recv = attr_chain(node.value)
+                    if recv is not None and recv.split(".")[-1] == "lax":
+                        out.append(Finding(
+                            "version-guard", sf.rel, node.lineno,
+                            f"lax.{node.attr} does not exist on jax 0.4.x "
+                            f"— use the ring.py pvary shim"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# Rule 6: metric/flag hygiene
+# --------------------------------------------------------------------------
+# The /vars surface is the operational contract (PR 5): a g_* var that is
+# never exposed is invisible; a name exposed twice raises at import in one
+# order and silently shadows in another; a flags.get("name") with no
+# define() anywhere raises FlagError at first read — in production, on the
+# hot path. All three are whole-package properties no single-file review
+# can check.
+
+_METRIC_CTORS = {"Adder", "Maxer", "Miner", "PassiveStatus", "Status",
+                 "LatencyRecorder", "_PassiveStatus"}
+
+
+def _call_last_name(node: ast.Call) -> Optional[str]:
+    """Last name component of a call target, robust to chains rooted in
+    another call (``_PassiveStatus(...).expose`` -> "expose")."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _registered_name(call: ast.Call) -> Optional[str]:
+    """Constant exposure name carried by a metric construction chain:
+    Adder("g_x"), X(...).expose("g_x"), X(...).expose_as("g_x")."""
+    node = call
+    while isinstance(node, ast.Call):
+        last = _call_last_name(node)
+        if last in ("expose", "expose_as", "Adder") and node.args:
+            s = const_str(node.args[0])
+            if s is not None:
+                return s
+        func = node.func
+        node = func.value if isinstance(func, ast.Attribute) else None
+    return None
+
+
+def _is_metric_ctor_chain(call: ast.Call) -> bool:
+    node = call
+    while isinstance(node, ast.Call):
+        if _call_last_name(node) in _METRIC_CTORS:
+            return True
+        func = node.func
+        node = func.value if isinstance(func, ast.Attribute) else None
+    return False
+
+
+@register_rule(
+    "metric-flag-hygiene",
+    "every g_* metric registered exactly once under its own name; every "
+    "flags.get() literal has a define() somewhere in the package")
+def rule_metric_flag_hygiene(pkg: Package) -> List[Finding]:
+    defines: Set[str] = set()
+    exposures: Dict[str, List[Tuple[str, int]]] = {}
+    reads: List[Tuple[str, str, int]] = []
+    assigns: List[Tuple[str, ast.Call, str, int]] = []
+
+    for sf in pkg.files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                last = _call_last_name(node)
+                if last is None:
+                    continue
+                if last == "define" and node.args:
+                    s = const_str(node.args[0])
+                    if s is not None:
+                        defines.add(s)
+                elif last in ("expose", "expose_as") and node.args:
+                    s = const_str(node.args[0])
+                    if s is not None:
+                        exposures.setdefault(s, []).append(
+                            (sf.rel, node.lineno))
+                elif last == "Adder" and node.args:
+                    s = const_str(node.args[0])
+                    if s is not None:
+                        exposures.setdefault(s, []).append(
+                            (sf.rel, node.lineno))
+                elif last == "get" and isinstance(node.func, ast.Attribute):
+                    recv = attr_chain(node.func.value)
+                    if recv in ("flags", "_flags") and node.args:
+                        s = const_str(node.args[0])
+                        if s is not None:
+                            reads.append((s, sf.rel, node.lineno))
+            elif isinstance(node, ast.Assign):
+                if (len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and node.targets[0].id.startswith("g_")
+                        and isinstance(node.value, ast.Call)):
+                    assigns.append((node.targets[0].id, node.value,
+                                    sf.rel, node.lineno))
+
+    out: List[Finding] = []
+    for name, locs in sorted(exposures.items()):
+        if len(locs) > 1:
+            first = locs[0]
+            for rel, line in locs[1:]:
+                out.append(Finding(
+                    "metric-flag-hygiene", rel, line,
+                    f"metric {name!r} exposed more than once (first at "
+                    f"{first[0]}:{first[1]}) — duplicate exposure raises "
+                    f"or shadows depending on import order"))
+    for var, call, rel, line in assigns:
+        if not _is_metric_ctor_chain(call):
+            continue
+        reg = _registered_name(call)
+        if reg is None:
+            out.append(Finding(
+                "metric-flag-hygiene", rel, line,
+                f"{var} is a metric that is never exposed — name it "
+                f"({var} = Adder({var!r})) or drop the g_ prefix"))
+        elif reg != var:
+            out.append(Finding(
+                "metric-flag-hygiene", rel, line,
+                f"{var} registered under mismatched name {reg!r} — /vars "
+                f"consumers grep the variable name"))
+    for name, rel, line in reads:
+        if name not in defines:
+            out.append(Finding(
+                "metric-flag-hygiene", rel, line,
+                f"flags.get({name!r}) has no define() anywhere in the "
+                f"package — first read raises FlagError at runtime"))
+    return out

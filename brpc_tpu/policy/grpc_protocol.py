@@ -18,6 +18,7 @@ h2 frames are connection-scoped, not per-message cuttable.
 from __future__ import annotations
 
 import threading
+import time as _time
 import urllib.parse
 from typing import List, Optional, Tuple
 
@@ -328,6 +329,7 @@ class GrpcProtocol(Protocol):
             return
         cid, attempt_version, _svc, _method = ctx
         conn.sock.in_messages += 1
+        t0 = _time.perf_counter_ns()
         hdrs = dict(st.headers or [])
         trailer = dict(st.trailers or [])
         meta = rpc_meta_pb2.RpcMeta()
@@ -357,6 +359,10 @@ class GrpcProtocol(Protocol):
             hdrs.get("grpc-encoding", "gzip")) if compressed
             else _compress.COMPRESS_NONE)
         msg = ParsedMessage(self, meta, IOBuf(message))
+        # trailer/meta assembly + length-prefix split is wire-format
+        # parsing done on the h2 frame path; credit it to the span's
+        # parse mark when the dispatcher stamps it
+        msg.pre_parse_us = (_time.perf_counter_ns() - t0) / 1000.0
         msg.socket = conn.sock
         from brpc_tpu.rpc.controller import handle_response_message
 

@@ -35,6 +35,7 @@ from brpc_tpu.rpc.protocol import (
     PARSE_BAD,
     PARSE_NOT_ENOUGH_DATA,
     PARSE_TRY_OTHERS,
+    ChunkedBodyCursor,
     ParsedMessage,
     PendingBodyCursor,
     Protocol,
@@ -211,6 +212,25 @@ def parse_http_message(buf: IOBuf, sock=None,
         except ValueError:
             return PARSE_BAD, None
         if decoded is None:
+            if proto is not None and can_stream_body(sock):
+                # incomplete chunked body on the cut-loop entry: pop the
+                # parsed headers and stream the chunk frames through an
+                # incremental cursor — each arriving chunk is claimed on
+                # arrival (credits return mid-message), and the unknown
+                # total length is discovered at the 0-size chunk
+                buf.pop_front(body_start)
+
+                def _finish_chunked(cur, msg=msg, proto=proto):
+                    msg.body = cur.body()
+                    return ParsedMessage(proto, msg, IOBuf(msg.body))
+
+                cursor = ChunkedBodyCursor(proto, finish=_finish_chunked)
+                cursor.feed(buf)
+                if cursor.failed:
+                    return PARSE_BAD, None
+                # cannot already be done: the whole-buffer decode above
+                # just said the body is incomplete
+                sock.pending_body = cursor
             return PARSE_NOT_ENOUGH_DATA, None
         msg.body, consumed = decoded
         buf.pop_front(body_start + consumed)

@@ -34,6 +34,7 @@ import threading
 import time as _time
 from typing import Dict, Optional, Set, Tuple
 
+from brpc_tpu.analysis.markers import poller_context
 from brpc_tpu.butil.endpoint import EndPoint
 from brpc_tpu.butil.iobuf import IOBuf
 from brpc_tpu.butil.resource_pool import VersionedPool
@@ -681,6 +682,7 @@ class NativeDataplane:
             self._proto_tstr = find_protocol("trpc_stream")
         return self._proto_trpc, self._proto_tstr
 
+    @poller_context
     def _poll_loop(self) -> None:
         """Packed batch loop (VERDICT r3 #1): ONE ctypes call returns a
         whole batch of events inlined into a reusable buffer; the loop

@@ -37,8 +37,8 @@ from brpc_tpu.rpc import errors
 _socket_pool: VersionedPool = VersionedPool()
 
 # global traffic counters (exposed later via /vars)
-g_in_bytes = Adder()
-g_out_bytes = Adder()
+g_in_bytes = Adder("g_in_bytes")
+g_out_bytes = Adder("g_out_bytes")
 
 _fault.register("socket.write.fail",
                 "fail the socket on the next write(); pending calls get "

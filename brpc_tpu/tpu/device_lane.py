@@ -42,8 +42,8 @@ from brpc_tpu.rpc import errors
 from brpc_tpu.rpc.server import Service
 from brpc_tpu.proto import device_lane_pb2
 
-g_device_resident_bytes = Adder()
-g_device_moved_bytes = Adder()
+g_device_resident_bytes = Adder("g_device_resident_bytes")
+g_device_moved_bytes = Adder("g_device_moved_bytes")
 
 
 class DeviceStore:

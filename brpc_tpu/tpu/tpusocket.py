@@ -38,8 +38,8 @@ from brpc_tpu.rpc import errors
 from brpc_tpu.rpc.protocol import ParsedMessage
 
 # device-side traffic counters (the /vars view of the "ICI NIC")
-g_tpu_in_bytes = Adder()
-g_tpu_out_bytes = Adder()
+g_tpu_in_bytes = Adder("g_tpu_in_bytes")
+g_tpu_out_bytes = Adder("g_tpu_out_bytes")
 
 _fault.register("tpu.device.crash",
                 "raise inside a registered device method (loopback path); "

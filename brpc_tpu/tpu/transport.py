@@ -51,6 +51,8 @@ from multiprocessing import shared_memory as _shm
 from typing import Dict, List, Optional, Tuple
 
 from brpc_tpu import fault as _fault
+from brpc_tpu.analysis import runtime_check as _rc
+from brpc_tpu.analysis.markers import poller_context
 from brpc_tpu.butil.endpoint import EndPoint
 from brpc_tpu.butil.iobuf import IOBuf
 from brpc_tpu.butil.resource_pool import VersionedPool
@@ -272,6 +274,8 @@ class BlockPool:
         self._exports = 0          # borrowed views currently alive
         self._close_pending = False
         self._closed = False
+        if _rc.ACTIVE:
+            _rc.ledger.track_pool(self, label="block_pool", owner=self.name)
 
     def view(self, idx: int, length: int) -> memoryview:
         if not (0 <= idx < self.block_count and 0 <= length <= self.block_size):
@@ -281,10 +285,14 @@ class BlockPool:
 
     # ------------------------------------------------------- borrow tracking
     def add_export(self) -> None:
+        if _rc.ACTIVE:
+            _rc.ledger.export_added(self)
         with self._lock:
             self._exports += 1
 
     def drop_export(self) -> None:
+        if _rc.ACTIVE:
+            _rc.ledger.export_dropped(self)
         with self._lock:
             self._exports -= 1
             retry = self._close_pending and self._exports <= 0 \
@@ -355,6 +363,9 @@ class PeerWindow:
         self._free = deque(range(block_count))
         self._cond = threading.Condition()
         self._closed = False
+        if _rc.ACTIVE:
+            _rc.ledger.track_window(self, block_count,
+                                    label="peer_window", owner=name)
 
     def acquire(self, want: int, timeout: float = 30.0) -> Optional[List[int]]:
         """Return 1..want block indices, parking until at least one is free.
@@ -369,14 +380,22 @@ class PeerWindow:
             if self._closed:
                 return None
             take = min(want, len(self._free))
-            return [self._free.popleft() for _ in range(take)]
+            got = [self._free.popleft() for _ in range(take)]
+        if _rc.ACTIVE:
+            _rc.ledger.window_acquired(self, len(got))
+        return got
 
     def release(self, indices) -> None:
+        indices = list(indices)
+        if _rc.ACTIVE:
+            _rc.ledger.window_released(self, len(indices))
         with self._cond:
             self._free.extend(indices)
             self._cond.notify_all()
 
     def close(self) -> None:
+        if _rc.ACTIVE:
+            _rc.ledger.window_closed(self)
         with self._cond:
             self._closed = True
             self._cond.notify_all()
@@ -527,13 +546,14 @@ class TpuEndpoint:
         self.inline_only = False          # cross-host fallback
         self.peer_ordinal = -1
         self.ready = threading.Event()
-        self._send_lock = threading.Lock()
+        self._send_lock = _rc.tracked_lock("TpuEndpoint._send_lock")
         self._failed = False
-        self._fail_lock = threading.Lock()
+        self._fail_lock = _rc.tracked_lock("TpuEndpoint._fail_lock")
         # ---- deferred-credit accounting (zero-copy receive) ----
         # RLock: a borrowed block's release hook can fire from a dealloc
         # cascade triggered on a thread already inside the ack machinery
-        self._ack_lock = threading.RLock()
+        self._ack_lock = _rc.tracked_lock("TpuEndpoint._ack_lock",
+                                          threading.RLock())
         self._ack_pending: List[int] = []   # credits awaiting one FT_ACK
         self._ack_hold = 0                  # >0: a cut batch is open, defer
         self._borrowed_outstanding = 0      # blocks lent to the parse path
@@ -860,29 +880,40 @@ class TpuEndpoint:
                 # window wedged or closed
                 return errors.EOVERCROWDED, sent > 0
             segs = []
-            for idx in got:
-                # fill this registered block from consecutive source views
-                # — one memcpy per (view, block) intersection, no flatten
-                blk_off = 0
-                base = idx * bs
-                buf = win._shm.buf
-                while blk_off < bs and sent < total:
-                    v = views[vi]
-                    take = min(bs - blk_off, len(v) - voff)
-                    buf[base + blk_off:base + blk_off + take] = \
-                        v[voff:voff + take]
-                    blk_off += take
-                    voff += take
-                    sent += take
-                    if voff == len(v):
-                        vi += 1
-                        voff = 0
-                segs.append((idx, blk_off))
-                if sent >= total:
-                    break
-            body = struct.pack(DATA_BODY_HDR, self.epoch, 0, len(segs))
-            body += b"".join(struct.pack(SEG_FMT, i, ln) for i, ln in segs)
-            rc = self._write_data_frame(_pack_frame(FT_DATA, body))
+            try:
+                for idx in got:
+                    # fill this registered block from consecutive source
+                    # views — one memcpy per (view, block) intersection,
+                    # no flatten
+                    blk_off = 0
+                    base = idx * bs
+                    buf = win._shm.buf
+                    while blk_off < bs and sent < total:
+                        v = views[vi]
+                        take = min(bs - blk_off, len(v) - voff)
+                        buf[base + blk_off:base + blk_off + take] = \
+                            v[voff:voff + take]
+                        blk_off += take
+                        voff += take
+                        sent += take
+                        if voff == len(v):
+                            vi += 1
+                            voff = 0
+                    segs.append((idx, blk_off))
+                    if sent >= total:
+                        break
+                body = struct.pack(DATA_BODY_HDR, self.epoch, 0, len(segs))
+                body += b"".join(struct.pack(SEG_FMT, i, ln)
+                                 for i, ln in segs)
+                rc = self._write_data_frame(_pack_frame(FT_DATA, body))
+            except BaseException:
+                # none of these credits reached the peer's byte stream, so
+                # the peer will never ACK them back — returning them here
+                # is the only thing standing between one bad memcpy (or a
+                # torn pipe raising out of the frame write) and a window
+                # that is permanently `need` credits smaller
+                win.release(list(got))
+                raise
             if rc != 0:
                 # the frame never entered the peer's byte stream — return
                 # the acquired credits, else they leak forever (the peer
@@ -897,6 +928,7 @@ class TpuEndpoint:
         return 0, False
 
     # -------------------------------------------------------------- recv path
+    @poller_context
     def on_data(self, body: IOBuf) -> None:
         """Runs inline on the dispatcher parse loop — append stream bytes in
         arrival order, cut complete messages (processing itself fans out to
@@ -1007,10 +1039,13 @@ class TpuEndpoint:
             self._ack_pending = []
         self._write_ack(acks)
 
+    @poller_context
     def _write_ack(self, acks: List[int]) -> None:
         if not acks:
             return
-        _fault.maybe_sleep(_fault.hit("tpu.ack.stall"))
+        # chaos injection point: stalling the ACK path *is* the experiment
+        # (zero-cost no-op unless a test arms tpu.ack.stall)
+        _fault.maybe_sleep(_fault.hit("tpu.ack.stall"))  # tpulint: disable=no-blocking-in-poller
         if _fault.hit("tpu.ack.drop") is not None:
             return  # credits vanish: the peer's window wedges until heal
         body = struct.pack(f"!{len(acks) + 2}I", self.epoch, len(acks),
@@ -1028,6 +1063,7 @@ class TpuEndpoint:
         with self._ack_lock:
             self._ack_hold += 1
 
+    @poller_context
     def cut_batch_end(self) -> None:
         with self._ack_lock:
             self._ack_hold -= 1
@@ -1037,6 +1073,7 @@ class TpuEndpoint:
             self._ack_pending = []
         self._write_ack(acks)
 
+    @poller_context
     def cut_body_complete(self) -> None:
         """End-of-body wakeup (the ROADMAP follow-on to streaming parse):
         a pending-body cursor just finished, which means the cut loop is
@@ -1052,6 +1089,7 @@ class TpuEndpoint:
         g_tunnel_eob_wakeups.put(1)
         self._write_ack(acks)
 
+    @poller_context
     def on_ack(self, body: bytes) -> None:
         vals = struct.unpack(f"!{len(body) // 4}I", body[:len(body) & ~3])
         if len(vals) < 2:
@@ -1112,6 +1150,11 @@ class TpuEndpoint:
 
     def close(self) -> None:
         self._heal_enabled = False  # orderly shutdown: nothing to heal
+        if _rc.ACTIVE and self.window is not None:
+            # orderly close must find the window whole — credits for the
+            # final frames may still be riding the ctrl socket as ACKs, so
+            # give them a bounded moment to land before the verdict
+            _rc.ledger.window_teardown(self.window, wait=2.0)
         try:
             self.ctrl.write(_pack_frame(FT_BYE))
         except Exception:

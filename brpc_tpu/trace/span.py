@@ -73,7 +73,7 @@ class Span:
         self.peer = peer
         # wall clock for display/cross-process alignment only; all
         # durations come from the monotonic pair below.
-        self.start_us = time.time() * 1e6
+        self.start_us = time.time() * 1e6  # tpulint: disable=monotonic-clock
         self.end_us = 0.0
         self.start_mono_us = _mono_us()
         self.end_mono_us = 0.0
@@ -111,7 +111,8 @@ class Span:
         if self._ended:
             return
         self._ended = True
-        self.end_us = time.time() * 1e6
+        # display twin of end_mono_us, never differenced against a start
+        self.end_us = time.time() * 1e6  # tpulint: disable=monotonic-clock
         self.end_mono_us = _mono_us()
         self.error_code = error_code
         _account_phases(self.phases)

@@ -34,3 +34,23 @@ def pytest_configure(config):
         "markers", "slow: long-running tests excluded from the tier-1 run")
     config.addinivalue_line(
         "markers", "chaos: fault-injection tests (in the tier-1 budget)")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _brpc_tpu_check_ledger():
+    """With BRPC_TPU_CHECK=1 in the environment, assert at session exit
+    that every tracked credit window is whole and no borrowed block view
+    is still alive. A no-op in normal runs."""
+    yield
+    from brpc_tpu.analysis import runtime_check as _rc
+
+    if not _rc.ACTIVE:
+        return
+    try:
+        from brpc_tpu.tpu.transport import _sweep_deferred_pools as _drain
+    except Exception:
+        _drain = None
+    _rc.ledger.assert_balanced(drain=_drain)

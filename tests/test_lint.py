@@ -1,0 +1,423 @@
+"""tpulint rule tests: every rule fires on a bad fixture, stays quiet on
+the matching good one, and honors suppression comments — plus the
+meta-test that keeps the real tree at zero unsuppressed findings."""
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from brpc_tpu.analysis import list_rules, run_lint
+
+REPO = Path(__file__).resolve().parent.parent
+
+EXPECTED_RULES = {
+    "no-blocking-in-poller", "acquire-release", "monotonic-clock",
+    "lock-order", "version-guard", "metric-flag-hygiene",
+}
+
+
+def _lint(tmp_path, files, rules=None):
+    """Write {relpath: source} fixtures under tmp_path and lint the dir."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return run_lint(str(tmp_path), rules=rules)
+
+
+def _rules_hit(result):
+    return {f.rule for f in result.findings}
+
+
+# ---------------------------------------------------------------- registry
+def test_all_rules_registered():
+    assert {n for n, _ in list_rules()} == EXPECTED_RULES
+
+
+def test_unknown_rule_rejected(tmp_path):
+    with pytest.raises(ValueError, match="unknown lint rule"):
+        run_lint(str(tmp_path), rules=["no-such-rule"])
+
+
+def test_syntax_error_surfaces_as_finding(tmp_path):
+    res = _lint(tmp_path, {"broken.py": "def f(:\n"})
+    assert [f.rule for f in res.findings] == ["parse-error"]
+
+
+# ------------------------------------------------- no-blocking-in-poller
+class TestNoBlockingInPoller:
+    def test_sleep_in_dispatcher_module_fires(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/event_dispatcher.py": """\
+            import time
+            def run_once(self):
+                time.sleep(0.1)
+            """}, rules=["no-blocking-in-poller"])
+        assert len(res.findings) == 1
+        assert res.findings[0].line == 3
+
+    def test_untimed_acquire_in_cut_loop_fires(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/input_messenger.py": """\
+            def cut(self):
+                self._lock.acquire()
+            """}, rules=["no-blocking-in-poller"])
+        assert "no-blocking-in-poller" in _rules_hit(res)
+
+    def test_timed_and_nonblocking_acquire_pass(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/input_messenger.py": """\
+            def cut(self):
+                self._lock.acquire(timeout=1.0)
+                self._lock.acquire(blocking=False)
+                self._cond.wait(0.5)
+            """}, rules=["no-blocking-in-poller"])
+        assert res.clean
+
+    def test_same_code_outside_poller_scope_passes(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/server.py": """\
+            import time
+            def accept_loop(self):
+                time.sleep(0.1)
+                self._lock.acquire()
+            """}, rules=["no-blocking-in-poller"])
+        assert res.clean
+
+    def test_poller_context_marker_extends_scope(self, tmp_path):
+        res = _lint(tmp_path, {"anywhere.py": """\
+            from brpc_tpu.analysis.markers import poller_context
+            @poller_context
+            def on_data(self, body):
+                self._lock.acquire()
+            """}, rules=["no-blocking-in-poller"])
+        assert len(res.findings) == 1
+        assert res.findings[0].line == 4
+
+    def test_suppression_comment_silences(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/event_dispatcher.py": """\
+            import time
+            def run_once(self):
+                time.sleep(0.1)  # tpulint: disable=no-blocking-in-poller
+            """}, rules=["no-blocking-in-poller"])
+        assert res.clean and len(res.suppressed) == 1
+
+
+# --------------------------------------------------------- acquire-release
+class TestAcquireRelease:
+    def test_bare_acquire_fires(self, tmp_path):
+        res = _lint(tmp_path, {"tpu/transport.py": """\
+            def send(self, win):
+                got = win.acquire(4)
+                self.post(got)
+            """}, rules=["acquire-release"])
+        assert len(res.findings) == 1
+        assert "release" in res.findings[0].message
+
+    def test_release_in_except_passes(self, tmp_path):
+        res = _lint(tmp_path, {"tpu/transport.py": """\
+            def send(self, win):
+                got = win.acquire(4)
+                try:
+                    self.post(got)
+                except BaseException:
+                    win.release(got)
+                    raise
+            """}, rules=["acquire-release"])
+        assert res.clean
+
+    def test_release_in_finally_passes(self, tmp_path):
+        res = _lint(tmp_path, {"butil/iobuf.py": """\
+            def borrow(self, pool):
+                pool.add_export()
+                try:
+                    self.use(pool)
+                finally:
+                    pool.drop_export()
+            """}, rules=["acquire-release"])
+        assert res.clean
+
+    def test_release_hook_kwarg_passes(self, tmp_path):
+        res = _lint(tmp_path, {"tpu/transport.py": """\
+            def on_data(self, pool, view):
+                pool.add_export()
+                self.buf.append_user_data(view, release=self._hook)
+            """}, rules=["acquire-release"])
+        assert res.clean
+
+    def test_wrapper_forwarding_ownership_passes(self, tmp_path):
+        # a method NAMED acquire forwards ownership to its caller
+        res = _lint(tmp_path, {"tpu/transport.py": """\
+            def acquire(self, n):
+                return self._inner.acquire(n)
+            """}, rules=["acquire-release"])
+        assert res.clean
+
+    def test_out_of_scope_module_passes(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/stream.py": """\
+            def f(self, win):
+                got = win.acquire(4)
+            """}, rules=["acquire-release"])
+        assert res.clean
+
+
+# --------------------------------------------------------- monotonic-clock
+class TestMonotonicClock:
+    def test_wall_clock_in_trace_fires(self, tmp_path):
+        res = _lint(tmp_path, {"trace/span.py": """\
+            import time
+            def stamp(self):
+                self.t = time.time()
+            """}, rules=["monotonic-clock"])
+        assert len(res.findings) == 1
+
+    def test_wall_clock_in_transport_fires(self, tmp_path):
+        res = _lint(tmp_path, {"tpu/transport.py": """\
+            import time as _time
+            def stamp(self):
+                return _time.time()
+            """}, rules=["monotonic-clock"])
+        assert len(res.findings) == 1
+
+    def test_monotonic_passes(self, tmp_path):
+        res = _lint(tmp_path, {"trace/span.py": """\
+            import time
+            def stamp(self):
+                self.t = time.monotonic()
+                self.n = time.perf_counter_ns()
+            """}, rules=["monotonic-clock"])
+        assert res.clean
+
+    def test_wall_clock_outside_scope_passes(self, tmp_path):
+        res = _lint(tmp_path, {"policy/auth.py": """\
+            import time
+            def now(self):
+                return time.time()
+            """}, rules=["monotonic-clock"])
+        assert res.clean
+
+    def test_suppression_on_comment_line_above(self, tmp_path):
+        res = _lint(tmp_path, {"trace/span.py": """\
+            import time
+            def stamp(self):
+                # display-only wall clock
+                # tpulint: disable=monotonic-clock
+                self.t = time.time()
+            """}, rules=["monotonic-clock"])
+        assert res.clean and len(res.suppressed) == 1
+
+
+# -------------------------------------------------------------- lock-order
+class TestLockOrder:
+    def test_opposite_nesting_orders_fire(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/thing.py": """\
+            class Thing:
+                def f(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+                def g(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+            """}, rules=["lock-order"])
+        assert len(res.findings) == 1
+        assert "cycle" in res.findings[0].message
+
+    def test_consistent_order_passes(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/thing.py": """\
+            class Thing:
+                def f(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+                def g(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+            """}, rules=["lock-order"])
+        assert res.clean
+
+    def test_cycle_through_method_call_fires(self, tmp_path):
+        # f holds a_lock while calling h (which takes b_lock);
+        # g nests b_lock -> a_lock: cycle via one-level propagation
+        res = _lint(tmp_path, {"tpu/thing.py": """\
+            class Thing:
+                def f(self):
+                    with self._a_lock:
+                        self.h()
+                def h(self):
+                    with self._b_lock:
+                        pass
+                def g(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+            """}, rules=["lock-order"])
+        assert "lock-order" in _rules_hit(res)
+
+    def test_sequential_acquisition_passes(self, tmp_path):
+        res = _lint(tmp_path, {"tpu/thing.py": """\
+            class Thing:
+                def f(self):
+                    with self._a_lock:
+                        pass
+                    with self._b_lock:
+                        pass
+                def g(self):
+                    with self._b_lock:
+                        pass
+                    with self._a_lock:
+                        pass
+            """}, rules=["lock-order"])
+        assert res.clean
+
+    def test_outside_scope_passes(self, tmp_path):
+        res = _lint(tmp_path, {"metrics/thing.py": """\
+            class Thing:
+                def f(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+                def g(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+            """}, rules=["lock-order"])
+        assert res.clean
+
+
+# ------------------------------------------------------------ version-guard
+class TestVersionGuard:
+    def test_direct_shard_map_import_fires(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/x.py": """\
+            from jax.experimental.shard_map import shard_map
+            """}, rules=["version-guard"])
+        assert len(res.findings) == 1
+
+    def test_check_vma_kwarg_fires(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/x.py": """\
+            def f(smap, body, mesh):
+                return smap(body, mesh=mesh, check_vma=False)
+            """}, rules=["version-guard"])
+        assert len(res.findings) == 1
+
+    def test_lax_pvary_fires(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/x.py": """\
+            from jax import lax
+            def f(x):
+                return lax.pvary(x, "i")
+            """}, rules=["version-guard"])
+        assert len(res.findings) == 1
+
+    def test_shim_modules_exempt(self, tmp_path):
+        res = _lint(tmp_path, {"tpu/collective.py": """\
+            from jax.experimental.shard_map import shard_map
+            def f(smap, body, mesh):
+                return smap(body, mesh=mesh, check_vma=False)
+            """}, rules=["version-guard"])
+        assert res.clean
+
+    def test_plain_jax_usage_passes(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/x.py": """\
+            import jax
+            import jax.numpy as jnp
+            def f(x):
+                return jax.jit(jnp.sum)(x)
+            """}, rules=["version-guard"])
+        assert res.clean
+
+
+# ---------------------------------------------------- metric-flag-hygiene
+class TestMetricFlagHygiene:
+    def test_unnamed_g_metric_fires(self, tmp_path):
+        res = _lint(tmp_path, {"mod.py": """\
+            from brpc_tpu.metrics.reducer import Adder
+            g_orphan = Adder()
+            """}, rules=["metric-flag-hygiene"])
+        assert len(res.findings) == 1
+        assert "never exposed" in res.findings[0].message
+
+    def test_mismatched_registration_fires(self, tmp_path):
+        res = _lint(tmp_path, {"mod.py": """\
+            from brpc_tpu.metrics.reducer import Adder
+            g_reads = Adder("g_writes")
+            """}, rules=["metric-flag-hygiene"])
+        assert len(res.findings) == 1
+        assert "mismatched" in res.findings[0].message
+
+    def test_duplicate_exposure_fires(self, tmp_path):
+        res = _lint(tmp_path, {
+            "a.py": 'from m import Adder\ng_dup = Adder("g_dup")\n',
+            "b.py": 'from m import Adder\ng_dup = Adder("g_dup")\n',
+        }, rules=["metric-flag-hygiene"])
+        assert len(res.findings) == 1
+        assert "more than once" in res.findings[0].message
+
+    def test_undeclared_flag_read_fires(self, tmp_path):
+        res = _lint(tmp_path, {"mod.py": """\
+            from brpc_tpu import flags
+            def f():
+                return flags.get("never_defined_anywhere")
+            """}, rules=["metric-flag-hygiene"])
+        assert len(res.findings) == 1
+        assert "FlagError" in res.findings[0].message
+
+    def test_clean_registration_passes(self, tmp_path):
+        res = _lint(tmp_path, {"mod.py": """\
+            from brpc_tpu import flags
+            from brpc_tpu.metrics.reducer import Adder
+            from brpc_tpu.metrics.status import PassiveStatus
+            g_named = Adder("g_named")
+            g_passive = PassiveStatus(lambda: 1).expose("g_passive")
+            flags.define("my_knob", 3, "a knob")
+            def f():
+                return flags.get("my_knob")
+            """}, rules=["metric-flag-hygiene"])
+        assert res.clean
+
+
+# ------------------------------------------------------------- suppression
+def test_disable_all_wildcard(tmp_path):
+    res = _lint(tmp_path, {"trace/span.py": """\
+        import time
+        def stamp(self):
+            self.t = time.time()  # tpulint: disable=all
+        """})
+    assert res.clean and res.suppressed
+
+
+# ---------------------------------------------------------------- meta-test
+def test_repo_tree_has_zero_unsuppressed_findings():
+    """The tentpole's acceptance bar: the shipped package itself is clean.
+    Every suppression in-tree is a deliberate, commented exception."""
+    res = run_lint(str(REPO / "brpc_tpu"))
+    assert res.clean, "\n" + "\n".join(f.format() for f in res.findings)
+
+
+def test_cli_exit_codes(tmp_path):
+    env = dict(PYTHONPATH=str(REPO), PATH="/usr/bin:/bin",
+               JAX_PLATFORMS="cpu")
+    clean = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "tpulint.py"),
+         str(REPO / "brpc_tpu")],
+        capture_output=True, text=True, env=env)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+    bad = tmp_path / "trace" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("import time\nt = time.time()\n")
+    dirty = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "tpulint.py"), str(tmp_path)],
+        capture_output=True, text=True, env=env)
+    assert dirty.returncode == 1
+    assert "[monotonic-clock]" in dirty.stdout
+
+
+def test_cli_list_rules():
+    env = dict(PYTHONPATH=str(REPO), PATH="/usr/bin:/bin")
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "tpulint.py"), "--list-rules"],
+        capture_output=True, text=True, env=env)
+    assert out.returncode == 0
+    for rule in EXPECTED_RULES:
+        assert rule in out.stdout
