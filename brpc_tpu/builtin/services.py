@@ -396,6 +396,65 @@ def tpu_service(server, http: HttpMessage):
     return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
 
 
+# ---------------------------------------------------------------------- dump
+def dump_service(server, http: HttpMessage):
+    """rpc_dump sampler state: gates, g_dump_* counters, the per-method
+    sample histogram, and the dump files on disk. ``?format=json`` for the
+    structured snapshot."""
+    from brpc_tpu.trace import rpc_dump as _dump
+
+    state = {
+        "rpc_dump_ratio": _flags.get("rpc_dump_ratio"),
+        "rpc_dump_max_per_sec": _flags.get("rpc_dump_max_per_sec"),
+        "sampled": _dump.g_dump_sampled.get_value(),
+        "skipped": _dump.g_dump_skipped.get_value(),
+        "bytes": _dump.g_dump_bytes.get_value(),
+        "rotations": _dump.g_dump_rotations.get_value(),
+        "errors": _dump.g_dump_errors.get_value(),
+    }
+    dumper = getattr(server, "rpc_dumper", None) if server is not None else None
+    if dumper is not None:
+        st = dumper.state()
+        try:
+            st["files"] = [
+                {"name": f,
+                 "bytes": os.path.getsize(os.path.join(st["directory"], f))}
+                for f in sorted(os.listdir(st["directory"]))
+                if f.endswith(".dump")]
+        except OSError:
+            st["files"] = []
+        state["dumper"] = st
+    if http.query.get("format", "") == "json":
+        return 200, CONTENT_JSON, json.dumps(state, indent=2) + "\n"
+    lines = [f"rpc_dump_ratio: {state['rpc_dump_ratio']}",
+             f"rpc_dump_max_per_sec: {state['rpc_dump_max_per_sec']}",
+             f"sampled: {state['sampled']}  skipped: {state['skipped']}  "
+             f"errors: {state['errors']}",
+             f"bytes: {state['bytes']}  rotations: {state['rotations']}"]
+    if dumper is None:
+        lines.append("")
+        lines.append("this server has no dumper "
+                     "(start with ServerOptions(rpc_dump_dir=...))")
+    else:
+        st = state["dumper"]
+        lines.append(f"directory: {st['directory']} "
+                     f"(file {st['file_index']}, {st['file_bytes']}B of "
+                     f"{st['max_file_bytes']}B)")
+        lines.append("")
+        lines.append("== per-method samples ==")
+        if not st["per_method"]:
+            lines.append("(none)")
+        for m, n in sorted(st["per_method"].items()):
+            lines.append(f"{m}: {n}")
+        lines.append("")
+        lines.append("== files ==")
+        if not st["files"]:
+            lines.append("(none)")
+        for f in st["files"]:
+            lines.append(f"{f['name']}: {f['bytes']}B")
+    return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
+
+
 # --------------------------------------------------------------------- fault
 def fault_service(server, http: HttpMessage):
     """Chaos console: inspect / arm / disarm injection points at runtime.
@@ -472,3 +531,6 @@ register_builtin("vlog", vlog_service,
                  "verbose-log sites (/vlog?setlevel=module=N)")
 register_builtin("fault", fault_service,
                  "fault injection points (/fault/arm?point=<name>)")
+register_builtin("dump", dump_service,
+                 "rpc_dump sampler state: counters, per-method histogram, "
+                 "dump files")

@@ -168,6 +168,15 @@ rpc_dump_ratio = define(
     "rpc_dump_ratio", 0.0,
     "fraction of requests sampled to dump files",
     validator=lambda v: 0 <= v <= 1)
+rpc_dump_max_per_sec = define(
+    "rpc_dump_max_per_sec", 0,
+    "hard cap on dump records written per second, enforced by a "
+    "monotonic-clock token bucket after the ratio draw (0 = no cap "
+    "beyond the shared collector budget)", validator=_non_negative)
+span_export_path = define(
+    "span_export_path", "",
+    "append every finished span to this file as one OTLP-shaped JSON "
+    "line (trace/export.py); empty disables export", reloadable=True)
 event_dispatcher_num = define(
     "event_dispatcher_num", 2,
     "number of IO event loops sockets are spread across "

@@ -334,11 +334,11 @@ class Server:
             # tunnels; plain TRPC/HTTP on the same port still works
             self._tpu_ordinal = ep.device_ordinal
         # engine-parsed EV_REQUEST fast path: only when no option needs the
-        # raw meta per request (auth tokens / interceptor / rpc_dump ride
-        # the full pipeline)
+        # raw meta per request (auth tokens / interceptor ride the full
+        # pipeline; rpc_dump samples natively — the meta pb is rebuilt for
+        # the sampled few, so dumping no longer forces the slow lane)
         fastpath = (self.options.auth is None
-                    and self.options.interceptor is None
-                    and self.rpc_dumper is None)
+                    and self.options.interceptor is None)
         self._native_lid, port = dp.listen(self, host, ep.port,
                                            tpu_ordinal=tpu_ordinal,
                                            fastpath=fastpath)

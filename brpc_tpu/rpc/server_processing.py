@@ -28,6 +28,11 @@ g_server_deadline_expired = Adder("g_server_deadline_expired")
 _fault.register("rpc.handler.crash",
                 "raise inside the service method (both dispatch paths) — "
                 "must surface as EINTERNAL, never a dead connection")
+_fault.register("rpc.handler.delay",
+                "sleep delay_ms inside the service method (both dispatch "
+                "paths) before user code runs — the stall lands in the "
+                "span's execute_us phase, so a record->replay->diff loop "
+                "must localize it there (match_method= filters)")
 
 # phase marks other layers may stamp while user code runs: handler wall
 # time is reported net of these so a span's phases stay additive
@@ -157,6 +162,9 @@ def process_rpc_request(protocol, msg, server) -> None:
         return send_error(*err)
     # `entry` accounting from here on settles exactly once through _settle.
     settled = [False]
+    # v2 dump record opened at dispatch, committed at settle so it carries
+    # the span's COMPLETE phase timeline (rpc_dump.RpcDumper.begin/commit)
+    pending_dump = [None]
 
     def _settle(error_code: int) -> None:
         if settled[0]:
@@ -166,6 +174,10 @@ def process_rpc_request(protocol, msg, server) -> None:
         server.sub_concurrency()
         if cntl.span is not None:
             cntl.span.end(error_code)
+        if pending_dump[0] is not None:
+            dumper = getattr(server, "rpc_dumper", None)
+            if dumper is not None:
+                dumper.commit(pending_dump[0], cntl.span, error_code)
 
     responded = [False]
 
@@ -216,7 +228,7 @@ def process_rpc_request(protocol, msg, server) -> None:
             cntl.span.request_size = len(payload) + len(attachment)
         dumper = getattr(server, "rpc_dumper", None)
         if dumper is not None and dumper.ask_to_be_sampled():
-            dumper.sample(meta, payload + attachment)
+            pending_dump[0] = dumper.begin(meta, payload + attachment)
         checksum_ok = protocol.verify_checksum(meta, payload)
         if cntl.span is not None:
             # attachment split + checksum walk the whole body: wire-format
@@ -247,6 +259,9 @@ def process_rpc_request(protocol, msg, server) -> None:
         try:
             if _fault.hit("rpc.handler.crash") is not None:
                 raise RuntimeError("fault injected handler crash")
+            _fault.maybe_sleep(
+                _fault.hit("rpc.handler.delay",
+                           method=meta.request.method_name))
             ret = entry.fn(cntl, request, done)
         except Exception as e:  # user bug -> EINTERNAL, not a dead connection
             cntl.set_failed(errors.EINTERNAL, f"method raised: {e}")
@@ -327,16 +342,10 @@ class FastServerController:
                          "(this request arrived via a binary protocol)")
 
 
-def _replay_full(item) -> None:
-    """Rebuild the RpcMeta pb and take the complete pipeline — for servers
-    whose options demand the meta (auth/interceptor/rpc_dump) when a fast
-    event arrives anyway (options changed after start)."""
-    (server, sock, svc, meth, cid, attempt, att_size, log_id, trace_id,
-     span_id, timeout_ms, body) = item
-    from brpc_tpu.butil.iobuf import IOBuf
-    from brpc_tpu.rpc.protocol import ParsedMessage, find_protocol
-
-    proto = find_protocol("trpc_std")
+def _rebuild_meta(svc, meth, cid, attempt, att_size, log_id, trace_id,
+                  span_id, timeout_ms) -> rpc_meta_pb2.RpcMeta:
+    """RpcMeta pb from the engine-cracked EV_REQUEST fields (the fast path
+    drops the pb; full-pipeline replay and dump records need it back)."""
     meta = rpc_meta_pb2.RpcMeta()
     meta.request.service_name = svc
     meta.request.method_name = meth
@@ -347,6 +356,21 @@ def _replay_full(item) -> None:
     meta.correlation_id = cid
     meta.attempt_version = attempt
     meta.attachment_size = att_size
+    return meta
+
+
+def _replay_full(item) -> None:
+    """Rebuild the RpcMeta pb and take the complete pipeline — for servers
+    whose options demand per-request hooks (auth/interceptor) when a fast
+    event arrives anyway (options changed after start)."""
+    (server, sock, svc, meth, cid, attempt, att_size, log_id, trace_id,
+     span_id, timeout_ms, body) = item
+    from brpc_tpu.butil.iobuf import IOBuf
+    from brpc_tpu.rpc.protocol import ParsedMessage, find_protocol
+
+    proto = find_protocol("trpc_std")
+    meta = _rebuild_meta(svc, meth, cid, attempt, att_size, log_id,
+                         trace_id, span_id, timeout_ms)
     msg = ParsedMessage(proto, meta, IOBuf(body))
     msg.socket = sock
     process_rpc_request(proto, msg, server)
@@ -381,8 +405,7 @@ def fast_process_request(item) -> None:
     if server is None:
         return
     if (server.options.auth is not None
-            or server.options.interceptor is not None
-            or server.rpc_dumper is not None):
+            or server.options.interceptor is not None):
         return _replay_full(item)
 
     # span exists BEFORE admission: rejected requests must reach /rpcz
@@ -441,11 +464,23 @@ def fast_process_request(item) -> None:
         # the engine dispatches EV_REQUEST promptly, so the budget starts
         # (approximately) now; batch enqueue re-checks this deadline
         cntl.deadline_mono = time.monotonic() + timeout_ms / 1000.0
+
+    # dump sampling rides the fast path natively (no full-pipeline replay):
+    # the meta pb is rebuilt only for the sampled few, before the
+    # attachment split so the record's body is the whole wire payload
+    dumper = server.rpc_dumper
+    pending_dump = None
+    if dumper is not None and dumper.ask_to_be_sampled():
+        pending_dump = dumper.begin(
+            _rebuild_meta(svc, meth, cid, attempt, att_size, log_id,
+                          trace_id, span_id, timeout_ms), body)
+
     if att_size:
         cntl.request_attachment = body[len(body) - att_size:]
         body = body[:len(body) - att_size]
 
     done = _FastDone(dp, conn, cid, attempt, cntl, entry, server, start_us)
+    done.pending_dump = pending_dump
 
     try:
         t_parse = time.perf_counter_ns() if span is not None else 0
@@ -465,6 +500,7 @@ def fast_process_request(item) -> None:
         try:
             if _fault.hit("rpc.handler.crash") is not None:
                 raise RuntimeError("fault injected handler crash")
+            _fault.maybe_sleep(_fault.hit("rpc.handler.delay", method=meth))
             ret = entry.fn(cntl, request, done)
         except Exception as e:
             cntl.set_failed(errors.EINTERNAL, f"method raised: {e}")
@@ -490,7 +526,7 @@ class _FastDone:
     allocates once and runs on every RPC)."""
 
     __slots__ = ("dp", "conn", "cid", "attempt", "cntl", "entry", "server",
-                 "start_us", "responded", "settled")
+                 "start_us", "responded", "settled", "pending_dump")
 
     def __init__(self, dp, conn, cid, attempt, cntl, entry, server,
                  start_us):
@@ -504,6 +540,7 @@ class _FastDone:
         self.start_us = start_us
         self.responded = False
         self.settled = False
+        self.pending_dump = None
 
     def __call__(self, response=None) -> None:
         if self.responded:
@@ -540,6 +577,10 @@ class _FastDone:
         span = self.cntl.span
         if span is not None:
             span.end(error_code)
+        if self.pending_dump is not None:
+            dumper = self.server.rpc_dumper
+            if dumper is not None:
+                dumper.commit(self.pending_dump, span, error_code)
 
 
 def _send_response(protocol, sock, request_meta, code, text, payload,

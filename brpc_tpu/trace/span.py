@@ -117,6 +117,7 @@ class Span:
         self.error_code = error_code
         _account_phases(self.phases)
         _db_add(self)
+        _maybe_export(self)
 
     @property
     def latency_us(self) -> float:
@@ -212,6 +213,22 @@ def _account_phases(phases: Dict[str, float]) -> None:
         adder.put(int(phases[name]))
 
 
+# ------------------------------------------------------------------- export
+# OTLP/JSON-lines export hook (trace/export.py). Module cached after the
+# first ended span; with span_export_path empty the call is one dict
+# lookup, so untraced deployments pay nothing.
+_export_mod = None
+
+
+def _maybe_export(span: "Span") -> None:
+    global _export_mod
+    if _export_mod is None:
+        from brpc_tpu.trace import export as _export_mod_imported
+
+        _export_mod = _export_mod_imported
+    _export_mod.maybe_export(span)
+
+
 # -------------------------------------------------------------------- SpanDB
 _db: deque = deque(maxlen=SPAN_DB_CAPACITY)
 _by_trace: Dict[int, List[Span]] = {}
@@ -263,9 +280,63 @@ def spans_of_trace(trace_id: int) -> List[Span]:
 
 
 def trace_to_dict(trace_id: int) -> Dict[str, Any]:
-    """Whole-trace JSON export: trace -> spans -> phases/events."""
+    """Whole-trace JSON export: trace -> spans -> phases/events, plus the
+    stitched parent->child ``tree`` (client and server spans of one trace
+    nest by parent_span_id — the ids line up across processes)."""
+    spans = [sp.to_dict() for sp in spans_of_trace(trace_id)]
     return {"trace_id": f"{trace_id:016x}",
-            "spans": [sp.to_dict() for sp in spans_of_trace(trace_id)]}
+            "spans": spans,
+            "tree": build_span_tree(spans)}
+
+
+def build_span_tree(span_dicts: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Nest span dicts (``to_dict`` shape) into parent->children trees by
+    span id: a server span hangs under the client span that issued it, a
+    downstream client span under the server span whose handler made the
+    call. Returns the roots (spans whose parent isn't in the set), each
+    node a copy of the span dict plus a ``children`` list; siblings order
+    by wall-clock start."""
+    nodes = [{**d, "children": []} for d in span_dicts]
+    by_id: Dict[Any, Dict[str, Any]] = {}
+    for n in nodes:
+        by_id.setdefault(n.get("span_id"), n)
+    roots = []
+    for n in nodes:
+        parent = by_id.get(n.get("parent_span_id"))
+        if parent is not None and parent is not n:
+            parent["children"].append(n)
+        else:
+            roots.append(n)
+
+    def _sort(ns: List[Dict[str, Any]]) -> None:
+        ns.sort(key=lambda d: d.get("start_us", 0.0))
+        for d in ns:
+            _sort(d["children"])
+
+    _sort(roots)
+    return roots
+
+
+def merge_trace_docs(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Stitch trace exports from several processes into one doc: the
+    client half of a trace lives in the caller's span DB, the server half
+    in the callee's — fetch ``/rpcz/<trace_id>?format=json`` from each and
+    merge. Spans dedup by (span_id, kind); the result carries a rebuilt
+    ``tree``."""
+    seen = set()
+    spans: List[Dict[str, Any]] = []
+    tid = ""
+    for doc in docs:
+        for d in doc.get("spans", []):
+            key = (d.get("span_id"), d.get("kind"))
+            if key in seen:
+                continue
+            seen.add(key)
+            spans.append(d)
+        tid = tid or doc.get("trace_id", "")
+    spans.sort(key=lambda d: d.get("start_us", 0.0))
+    return {"trace_id": tid, "spans": spans,
+            "tree": build_span_tree(spans)}
 
 
 def reset_for_test() -> None:

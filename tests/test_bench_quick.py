@@ -141,3 +141,93 @@ def test_tunnel_counters_on_vars(bench_run):
     for name in ("g_tunnel_borrowed_bytes", "g_tunnel_copied_bytes",
                  "g_tunnel_borrowed_peak_blocks"):
         assert get_exposed(name) is not None, name
+
+
+def test_record_replay_diff_smoke(tmp_path):
+    """The record -> replay -> diff loop on the shm lane, end to end
+    through the CLI tools: ~2s of recorded echo traffic over tpu://, a 2x
+    open-loop replay via tools/rpc_replay, and tools/trace_diff comparing
+    the recorded phase timelines against the replayed ones — exit 0, no
+    regression flagged on an unchanged server."""
+    import json as _json
+    import time
+
+    from brpc_tpu import flags as _flags
+    from brpc_tpu.metrics.collector import global_collector
+    from brpc_tpu.proto import echo_pb2
+    from brpc_tpu.rpc import (Channel, ChannelOptions, Server,
+                              ServerOptions, Service, Stub)
+    from brpc_tpu.trace import span as _span
+    from tools import rpc_replay, trace_diff
+
+    ECHO = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+    class EchoImpl(Service):
+        DESCRIPTOR = ECHO
+
+        def Echo(self, cntl, request, done):
+            return echo_pb2.EchoResponse(message=request.message)
+
+    record_dir = tmp_path / "dumps"
+    _flags.set_flag("rpcz_sample_ratio", "1.0")
+    _flags.set_flag("rpc_dump_ratio", "1.0")
+    _flags.set_flag("collector_max_samples_per_second", "0")
+    global_collector()._deny_until = 0.0
+    _span.reset_for_test()
+    try:
+        server = (Server(ServerOptions(rpc_dump_dir=str(record_dir)))
+                  .add_service(EchoImpl()).start("tpu://127.0.0.1:0/0"))
+        try:
+            ch = Channel(ChannelOptions(protocol="trpc_std",
+                                        timeout_ms=10000))
+            ch.init(str(server.listen_endpoint()))
+            stub = Stub(ch, ECHO)
+            deadline = time.monotonic() + 2.0
+            sent = 0
+            while time.monotonic() < deadline and sent < 60:
+                stub.Echo(echo_pb2.EchoRequest(message=f"s{sent}"))
+                sent += 1
+                time.sleep(0.01)  # real inter-arrival gaps to halve
+            t = time.monotonic() + 2.0
+            while (server.rpc_dumper.sampled_count < sent
+                   and time.monotonic() < t):
+                time.sleep(0.01)
+            assert server.rpc_dumper.sampled_count >= sent
+            server.rpc_dumper.close()
+        finally:
+            server.stop()
+            server.join(timeout=2)
+        _flags.set_flag("rpc_dump_ratio", "0.0")
+
+        _span.reset_for_test()
+        server2 = Server().add_service(EchoImpl()).start("tpu://127.0.0.1:0/0")
+        try:
+            t0 = time.monotonic()
+            rc = rpc_replay.main([
+                "--dump", str(record_dir),
+                "--server", str(server2.listen_endpoint()),
+                "--rate-mult", "2", "--timeout-ms", "10000",
+                "--report-interval", "0"])
+            replay_s = time.monotonic() - t0
+            assert rc == 0
+            # 2x rate-mult: the ~1.5s+ recorded schedule replays in ~half
+            assert replay_s < 1.5, f"2x replay took {replay_s:.2f}s"
+            t = time.monotonic() + 2.0
+            while (len([s for s in _span.recent_spans(200)
+                        if s.kind == _span.KIND_SERVER]) < sent
+                   and time.monotonic() < t):
+                time.sleep(0.01)
+        finally:
+            server2.stop()
+            server2.join(timeout=2)
+        replayed = tmp_path / "replayed.json"
+        replayed.write_text(_json.dumps({"spans": [
+            s.to_dict() for s in _span.recent_spans(200)]}))
+        # p50 + 10ms floor: quiet on an unchanged server even on a noisy box
+        rc = trace_diff.main([str(record_dir), str(replayed),
+                              "--percentile", "50",
+                              "--min-delta-us", "10000"])
+        assert rc == 0
+    finally:
+        _flags.set_flag("rpc_dump_ratio", "0.0")
+        _flags.set_flag("collector_max_samples_per_second", "1000")

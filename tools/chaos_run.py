@@ -27,6 +27,16 @@ Usage::
 after the scenario finishes — pass/fail alike — and writes them under DIR
 (``traces.json`` plus one ``trace_<id>.json`` per trace), ready for
 ``tools/trace_view.py`` to render the chaos run's waterfalls.
+
+Regression gate: record a scenario once with ``--save-baseline FILE``,
+then later runs pass ``--diff-baseline FILE`` to compare the current
+run's per-method phase timelines against the recording with
+``brpc_tpu.trace.diff`` — the run FAILS (rc 1) when any phase regressed,
+naming which phase moved::
+
+    python tools/chaos_run.py H:P S.json --save-baseline base.json
+    python tools/chaos_run.py H:P S.json --diff-baseline base.json \\
+        --diff-threshold 0.5 --diff-percentile 90
 """
 
 from __future__ import annotations
@@ -112,16 +122,52 @@ def dump_traces(target: str, out_dir: str) -> int:
     return len(by_trace)
 
 
+def save_baseline(target: str, path: str) -> int:
+    """Snapshot /rpcz?format=json to ``path`` as a diff baseline.
+    Returns the number of spans saved."""
+    doc = json.loads(_fetch(target, "/rpcz?format=json"))
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+    return len(doc.get("spans", []))
+
+
+def diff_baseline(target: str, path: str, *, threshold: float,
+                  percentile: float, min_delta_us: float) -> int:
+    """Compare this run's phase timelines against the baseline at
+    ``path``. Prints the report; returns the number of regressions."""
+    from brpc_tpu.trace import diff as _diff
+
+    base = _diff.load_profiles(path)
+    new = _diff.profiles_from_spans(
+        json.loads(_fetch(target, "/rpcz?format=json")).get("spans", []))
+    regs = _diff.diff_profiles(base, new, q=percentile,
+                               threshold=threshold,
+                               min_delta_us=min_delta_us)
+    sys.stdout.write(_diff.render_report(base, new, regs, percentile))
+    return len(regs)
+
+
+def _pop_opt(args: list, name: str, default=None):
+    """Extract ``name VALUE`` from args (None when absent)."""
+    if name not in args:
+        return default
+    i = args.index(name)
+    if i + 1 >= len(args):
+        print(__doc__, file=sys.stderr)
+        raise SystemExit(2)
+    value = args[i + 1]
+    del args[i:i + 2]
+    return value
+
+
 def main(argv) -> int:
     args = list(argv[1:])
-    dump_dir = None
-    if "--dump-traces" in args:
-        i = args.index("--dump-traces")
-        if i + 1 >= len(args):
-            print(__doc__, file=sys.stderr)
-            return 2
-        dump_dir = args[i + 1]
-        del args[i:i + 2]
+    dump_dir = _pop_opt(args, "--dump-traces")
+    base_out = _pop_opt(args, "--save-baseline")
+    base_in = _pop_opt(args, "--diff-baseline")
+    threshold = float(_pop_opt(args, "--diff-threshold", "0.30"))
+    percentile = float(_pop_opt(args, "--diff-percentile", "99")) / 100.0
+    min_delta = float(_pop_opt(args, "--diff-min-delta-us", "2000"))
     if len(args) != 2:
         print(__doc__, file=sys.stderr)
         return 2
@@ -139,6 +185,25 @@ def main(argv) -> int:
             print(f"chaos_run: dumped {n} traces to {dump_dir}")
         except (ScenarioError, OSError, ValueError) as e:
             print(f"chaos_run: trace dump failed: {e}", file=sys.stderr)
+            rc = rc or 1
+    if base_out is not None:
+        try:
+            n = save_baseline(target, base_out)
+            print(f"chaos_run: baseline of {n} spans saved to {base_out}")
+        except (ScenarioError, OSError, ValueError) as e:
+            print(f"chaos_run: baseline save failed: {e}", file=sys.stderr)
+            rc = rc or 1
+    if base_in is not None:
+        try:
+            regs = diff_baseline(target, base_in, threshold=threshold,
+                                 percentile=percentile,
+                                 min_delta_us=min_delta)
+            if regs:
+                print(f"chaos_run: FAILED: {regs} phase regression(s) vs "
+                      f"{base_in}", file=sys.stderr)
+                rc = rc or 1
+        except (ScenarioError, OSError, ValueError) as e:
+            print(f"chaos_run: baseline diff failed: {e}", file=sys.stderr)
             rc = rc or 1
     if rc == 0:
         print(f"chaos_run: OK ({summary['steps']} steps against "

@@ -1,14 +1,34 @@
 #!/usr/bin/env python
-"""rpc_replay — re-issue sampled requests from rpc_dump files
-(counterpart of the reference tools/rpc_replay).
+"""rpc_replay — replay rpc_dump traffic as a capacity probe
+(counterpart of the reference tools/rpc_replay, grown past it).
 
 Each dump record carries the original RpcMeta + serialized request body;
 replay re-sends the body to the original service/method on a new target
 through the full client stack (RawMessage passthrough — no message classes
 needed).
 
-Example:
+Pacing is OPEN-LOOP: v2 records stamp their arrival wall-clock timestamps,
+so the replay schedule preserves the recorded inter-arrival gaps divided
+by ``--rate-mult N`` (2.0 = twice the recorded rate), and requests are
+issued asynchronously under a bounded in-flight window — a slow server
+stretches its own latencies, not the offered load. That is what makes an
+N× replay a capacity probe rather than a closed loop that self-throttles.
+``--qps`` overrides with a fixed-rate schedule; v1 dumps (no timestamps)
+replay back-to-back under the in-flight cap.
+
+Trace tagging: each replayed call reuses the recorded trace_id, with the
+recorded client span as its parent — replayed server spans land in the
+target's /rpcz under the SAME trace ids as their recorded counterparts,
+so ``tools/trace_diff.py`` can align the two runs record-by-record.
+
+Soak: ``--loop N`` repeats the schedule N times (0 = until ``--duration``
+seconds elapse); a live ``qps/ok/fail/p50/p99`` readout prints every
+``--report-interval`` seconds on stderr.
+
+Examples:
     python tools/rpc_replay.py --dump /tmp/dumps --server 127.0.0.1:8000
+    python tools/rpc_replay.py --dump /tmp/dumps --server tpu://h:p/0 \\
+        --rate-mult 2 --loop 0 --duration 60
 """
 
 from __future__ import annotations
@@ -16,74 +36,230 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from brpc_tpu.metrics.latency_recorder import LatencyRecorder
 from brpc_tpu.policy import compress as _compress
-from brpc_tpu.rpc import Channel, ChannelOptions, Controller, MethodDescriptor, RpcError
+from brpc_tpu.rpc import Channel, ChannelOptions, Controller, MethodDescriptor
 from brpc_tpu.rpc.channel import RawMessage
-from brpc_tpu.trace.rpc_dump import RpcDumpLoader
+
+
+class _ReplayItem:
+    """One decoded dump record, ready to fire repeatedly."""
+
+    __slots__ = ("md", "payload", "attachment", "trace_id",
+                 "parent_span_id", "offset_s")
+
+    def __init__(self, md, payload, attachment, trace_id, parent_span_id):
+        self.md = md
+        self.payload = payload
+        self.attachment = attachment
+        self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
+        self.offset_s = 0.0
+
+
+def load_items(dump_path: str):
+    """Decode every dump record once: undo the attachment split and the
+    compression (the dump stores the wire form) so the client stack can
+    re-frame them. Returns (items, skipped)."""
+    from brpc_tpu.trace.rpc_dump import RpcDumpLoader
+
+    items, skipped = [], 0
+    recs = []
+    for rec in RpcDumpLoader(dump_path):
+        recs.append(rec)
+    # open-loop pacing follows arrival order; records commit at settle so
+    # the file order is completion order — re-sort by the arrival stamp
+    recs.sort(key=lambda r: r.ts_us)
+    t0 = next((r.ts_us for r in recs if r.ts_us > 0.0), 0.0)
+    for rec in recs:
+        meta, body = rec.meta, rec.body
+        md = MethodDescriptor(meta.request.service_name,
+                              meta.request.method_name,
+                              request_class=None,
+                              response_class=RawMessage)
+        att = meta.attachment_size
+        payload, attachment = (body[:-att], body[-att:]) if att else (body, b"")
+        try:
+            payload = _compress.decompress(payload, meta.compress_type)
+        except Exception as e:
+            skipped += 1
+            print(f"undecodable record skipped: {e}", file=sys.stderr)
+            continue
+        item = _ReplayItem(md, payload, attachment, rec.trace_id,
+                           rec.span_id)
+        if rec.ts_us > 0.0:
+            item.offset_s = max(0.0, (rec.ts_us - t0) / 1e6)
+        items.append(item)
+    return items, skipped
+
+
+class _Stats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.sent = 0
+        self.ok = 0
+        self.fail = 0
+        self.recorder = LatencyRecorder()
+        self.first_error = ""
+
+    def settle(self, cntl, latency_us: float) -> None:
+        with self.lock:
+            if cntl.failed():
+                self.fail += 1
+                if not self.first_error:
+                    self.first_error = (f"[E{cntl.error_code}] "
+                                        f"{cntl.error_text()}")
+            else:
+                self.ok += 1
+                self.recorder.record(latency_us)
 
 
 def main(argv=None) -> int:
-    p = argparse.ArgumentParser(description=__doc__)
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("--dump", required=True, help="dump file or directory")
     p.add_argument("--server", required=True, help="host:port target")
-    p.add_argument("--qps", type=int, default=0,
-                   help="replay rate; 0 = sequential full speed")
+    p.add_argument("--rate-mult", type=float, default=1.0,
+                   help="scale the recorded inter-arrival gaps: 2.0 "
+                        "replays at twice the recorded rate (default 1.0)")
+    p.add_argument("--qps", type=float, default=0.0,
+                   help="fixed-rate schedule overriding recorded gaps "
+                        "(0 = use recorded timestamps)")
     p.add_argument("--loop", type=int, default=1,
-                   help="times to replay the whole dump")
+                   help="times to replay the whole dump "
+                        "(0 = loop until --duration)")
+    p.add_argument("--duration", type=float, default=0.0,
+                   help="stop after this many seconds (soak mode)")
     p.add_argument("--timeout-ms", type=int, default=1000)
+    p.add_argument("--max-inflight", type=int, default=128,
+                   help="bound on concurrently outstanding requests")
+    p.add_argument("--report-interval", type=float, default=1.0,
+                   help="seconds between live qps/latency readouts "
+                        "(0 disables)")
+    p.add_argument("--no-trace-tag", action="store_true",
+                   help="do not reuse recorded trace ids on replayed calls")
+    p.add_argument("--protocol", default="trpc_std")
     args = p.parse_args(argv)
 
+    if args.rate_mult <= 0.0:
+        print("--rate-mult must be > 0", file=sys.stderr)
+        return 2
+    items, skipped = load_items(args.dump)
+    if not items:
+        print(f"no replayable records in {args.dump}", file=sys.stderr)
+        return 1
+    if args.qps > 0.0:
+        for i, item in enumerate(items):
+            item.offset_s = i / args.qps
+    else:
+        for item in items:
+            item.offset_s /= args.rate_mult
+
     channel = Channel(ChannelOptions(
-        timeout_ms=args.timeout_ms, max_retry=0)).init(args.server)
-    recorder = LatencyRecorder()
-    ok = fail = 0
-    interval = 1.0 / args.qps if args.qps > 0 else 0.0
-    next_fire = time.monotonic()
+        protocol=args.protocol, timeout_ms=args.timeout_ms,
+        max_retry=0)).init(args.server)
 
-    for _ in range(args.loop):
-        for meta, body in RpcDumpLoader(args.dump):
-            if interval:
-                now = time.monotonic()
-                if now < next_fire:
-                    time.sleep(next_fire - now)
-                next_fire += interval
-            md = MethodDescriptor(meta.request.service_name,
-                                  meta.request.method_name,
-                                  request_class=None,
-                                  response_class=RawMessage)
-            # the dump stores payload (possibly compressed) + attachment as
-            # recorded on the wire; replay must undo both so the stack can
-            # re-frame them for the new call
-            att = meta.attachment_size
-            payload, attachment = (body[:-att], body[-att:]) if att else (body, b"")
-            try:
-                payload = _compress.decompress(payload, meta.compress_type)
-            except Exception as e:
-                fail += 1
-                print(f"undecodable record skipped: {e}", file=sys.stderr)
-                continue
-            cntl = Controller()
-            cntl.request_attachment = attachment
-            start = time.perf_counter_ns()
-            try:
-                channel.call_method(md, RawMessage(payload),
-                                    response=RawMessage(), controller=cntl)
-                ok += 1
-                recorder.record((time.perf_counter_ns() - start) / 1000)
-            except (RpcError, ConnectionError) as e:
-                fail += 1
-                print(f"replay failed: {e}", file=sys.stderr)
+    from brpc_tpu.trace import span as _span
 
-    print(f"replayed ok {ok} failed {fail}")
-    if ok:
-        print(f"latency_avg_us {recorder.latency():.1f} "
-              f"p99_us {recorder.latency_percentile(0.99):.1f}")
-    return 0 if fail == 0 else 1
+    stats = _Stats()
+    inflight = threading.BoundedSemaphore(max(1, args.max_inflight))
+    stop_evt = threading.Event()
+
+    def reporter():
+        last_sent = 0
+        t0 = time.monotonic()
+        last_t = t0
+        while not stop_evt.wait(args.report_interval):
+            now = time.monotonic()
+            with stats.lock:
+                sent, ok, fail = stats.sent, stats.ok, stats.fail
+                p50 = stats.recorder.latency_percentile(0.5)
+                p99 = stats.recorder.latency_percentile(0.99)
+            qps = (sent - last_sent) / max(1e-9, now - last_t)
+            print(f"t={now - t0:6.1f}s sent={sent} ok={ok} fail={fail} "
+                  f"qps={qps:.0f} p50={p50 / 1000.0:.2f}ms "
+                  f"p99={p99 / 1000.0:.2f}ms", file=sys.stderr)
+            last_sent, last_t = sent, now
+
+    if args.report_interval > 0:
+        threading.Thread(target=reporter, name="replay-report",
+                         daemon=True).start()
+
+    def issue(item: _ReplayItem, pass_num: int) -> None:
+        cntl = Controller()
+        cntl.request_attachment = item.attachment
+        if item.trace_id and not args.no_trace_tag:
+            # replayed span: same trace as the recording, hung under the
+            # recorded client span so the stitched tree shows the pair
+            sp = _span.Span(item.trace_id, _span._gen_id(),
+                            item.parent_span_id, _span.KIND_CLIENT,
+                            item.md.service_name, item.md.method_name)
+            sp.annotate(f"replay pass={pass_num} "
+                        f"rate_mult={args.rate_mult:g}")
+            cntl.span = sp
+        t_start = time.perf_counter_ns()
+
+        def on_done(c):
+            stats.settle(c, (time.perf_counter_ns() - t_start) / 1000.0)
+            inflight.release()
+
+        try:
+            channel.call_method(item.md, RawMessage(item.payload),
+                                response=RawMessage(), controller=cntl,
+                                done=on_done)
+        except Exception as e:
+            inflight.release()
+            with stats.lock:
+                stats.fail += 1
+                if not stats.first_error:
+                    stats.first_error = str(e)
+
+    start = time.monotonic()
+    deadline = start + args.duration if args.duration > 0 else None
+    pass_num = 0
+    stopped = False
+    while not stopped:
+        pass_num += 1
+        base = time.monotonic()
+        for item in items:
+            if deadline is not None and time.monotonic() >= deadline:
+                stopped = True
+                break
+            fire_at = base + item.offset_s
+            now = time.monotonic()
+            if fire_at > now:
+                time.sleep(fire_at - now)
+            inflight.acquire()
+            with stats.lock:
+                stats.sent += 1
+            issue(item, pass_num)
+        if args.loop > 0 and pass_num >= args.loop:
+            break
+        if args.loop == 0 and deadline is None:
+            break  # loop-forever needs a duration to be finite
+    # drain: reclaim every in-flight permit before summarizing
+    for _ in range(max(1, args.max_inflight)):
+        inflight.acquire()
+    stop_evt.set()
+
+    elapsed = time.monotonic() - start
+    qps = stats.sent / max(1e-9, elapsed)
+    print(f"replayed ok {stats.ok} failed {stats.fail} skipped {skipped} "
+          f"passes {pass_num} elapsed {elapsed:.2f}s qps {qps:.0f}")
+    if stats.ok:
+        r = stats.recorder
+        print(f"latency_avg_us {r.latency():.1f} "
+              f"p50_us {r.latency_percentile(0.5):.1f} "
+              f"p99_us {r.latency_percentile(0.99):.1f}")
+    if stats.fail and stats.first_error:
+        print(f"first_error {stats.first_error}", file=sys.stderr)
+    return 0 if stats.fail == 0 else 1
 
 
 if __name__ == "__main__":

@@ -17,6 +17,11 @@ hit the target's HTTP port directly):
 
     python tools/rpc_view.py 127.0.0.1:8000 status
     python tools/rpc_view.py 127.0.0.1:8000 flags/idle_timeout_s --set 30
+
+Offline dump mode (no server): render rpc_dump files — record count,
+per-method histogram, byte totals, v1/v2 format detection:
+
+    python tools/rpc_view.py --dump /tmp/rpc_dumps
 """
 
 from __future__ import annotations
@@ -105,10 +110,44 @@ def serve(listen: str, target: str, *, protocol: str = "trpc_std",
     return srv
 
 
+def render_dump(path: str) -> str:
+    """Human summary of the rpc_dump file/dir at ``path``: record count,
+    per-method histogram, byte totals, and v1/v2 format detection."""
+    from brpc_tpu.trace.rpc_dump import RpcDumpLoader
+
+    per_method = {}
+    versions = {}
+    records = 0
+    meta_bytes = body_bytes = 0
+    with_phases = 0
+    for rec in RpcDumpLoader(path):
+        records += 1
+        versions[rec.version] = versions.get(rec.version, 0) + 1
+        per_method[rec.method_key] = per_method.get(rec.method_key, 0) + 1
+        meta_bytes += len(rec.meta.SerializeToString())
+        body_bytes += len(rec.body)
+        if rec.info.get("phases"):
+            with_phases += 1
+    fmt = "/".join(f"v{v}" for v in sorted(versions)) or "empty"
+    lines = [f"dump: {path}",
+             f"records: {records} ({fmt}; "
+             f"{with_phases} with phase timelines)",
+             f"bytes: {meta_bytes} meta + {body_bytes} body",
+             "",
+             "== per-method records =="]
+    if not per_method:
+        lines.append("(none)")
+    width = max((len(m) for m in per_method), default=0)
+    for m, n in sorted(per_method.items(), key=lambda kv: -kv[1]):
+        lines.append(f"{m:<{width}}  {n}")
+    return "\n".join(lines) + "\n"
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
-    p.add_argument("server", help="target host:port")
+    p.add_argument("server", nargs="?", default=None,
+                   help="target host:port (omit with --dump)")
     p.add_argument("page", nargs="?", default="status",
                    help="builtin page path (default: status)")
     p.add_argument("--serve", metavar="LISTEN", default=None,
@@ -121,7 +160,20 @@ def main(argv=None) -> int:
                    help="fetch over plain HTTP instead of the binary "
                         "protocol")
     p.add_argument("--timeout", type=float, default=5.0)
+    p.add_argument("--dump", metavar="PATH", default=None,
+                   help="render local rpc_dump file/dir instead of "
+                        "querying a server")
     args = p.parse_args(argv)
+
+    if args.dump is not None:
+        try:
+            sys.stdout.write(render_dump(args.dump))
+        except OSError as e:
+            print(f"cannot read {args.dump}: {e}", file=sys.stderr)
+            return 1
+        return 0
+    if args.server is None:
+        p.error("server is required unless --dump is given")
 
     if args.serve:
         serve(args.serve, args.server, protocol=args.protocol,

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""trace_diff — which PHASE moved between two runs of the same workload.
+
+Aligns recorded vs replayed (or baseline vs current) phase timelines
+per-method at a percentile and reports regressions like::
+
+    execute p99 +180% on EchoService.Echo (210us -> 590us, n=40/40)
+
+BASELINE and CURRENT each accept:
+
+- an rpc_dump v2 file or a directory of ``*.dump`` files (records carry
+  the server span's settled phases);
+- an ``/rpcz?format=json`` export file (chaos_run --dump-traces output);
+- a live ``host:port`` — fetched as ``/rpcz?format=json`` over HTTP.
+
+Exit code 0 = no regression, 1 = regression(s), 2 = usage error.
+
+Examples:
+    python tools/trace_diff.py /tmp/dumps /tmp/replay-rpcz.json
+    python tools/trace_diff.py baseline.json 127.0.0.1:8000 --threshold 0.5
+    python tools/trace_diff.py record/ replay/ --percentile 90 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from brpc_tpu.trace import diff as _diff
+
+_HOSTPORT = re.compile(r"^[\w.\-]+:\d+$")
+
+
+def load_source(src: str, kind: str = "server"):
+    """Profiles from a path (dump/JSON) or a live host:port target."""
+    if not os.path.exists(src) and _HOSTPORT.match(src):
+        from brpc_tpu.policy.http_protocol import http_fetch
+
+        resp = http_fetch(src, "GET", "/rpcz?format=json")
+        if resp.status // 100 != 2:
+            raise RuntimeError(f"GET /rpcz from {src} -> {resp.status}")
+        return _diff.profiles_from_spans(
+            json.loads(resp.body).get("spans", []), kind)
+    return _diff.load_profiles(src, kind)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("baseline", help="dump file/dir, rpcz JSON, or host:port")
+    p.add_argument("current", help="dump file/dir, rpcz JSON, or host:port")
+    p.add_argument("--percentile", type=float,
+                   default=_diff.DEFAULT_PERCENTILE * 100,
+                   help="percentile to compare, 0-100 (default 99)")
+    p.add_argument("--threshold", type=float,
+                   default=_diff.DEFAULT_THRESHOLD,
+                   help="relative move to flag, e.g. 0.30 = +30%% "
+                        "(default 0.30)")
+    p.add_argument("--min-delta-us", type=float,
+                   default=_diff.DEFAULT_MIN_DELTA_US,
+                   help="absolute move floor in us (default 2000)")
+    p.add_argument("--min-samples", type=int,
+                   default=_diff.DEFAULT_MIN_SAMPLES,
+                   help="skip methods with fewer samples on either side")
+    p.add_argument("--kind", default="server",
+                   help="span kind to compare from JSON sources "
+                        "(server/client/'' for both; default server)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    args = p.parse_args(argv)
+
+    q = args.percentile / 100.0
+    if not (0.0 < q <= 1.0):
+        print("--percentile must be in (0, 100]", file=sys.stderr)
+        return 2
+    try:
+        base = load_source(args.baseline, args.kind)
+        new = load_source(args.current, args.kind)
+    except (OSError, ValueError, RuntimeError) as e:
+        print(f"trace_diff: {e}", file=sys.stderr)
+        return 2
+
+    regs = _diff.diff_profiles(base, new, q=q, threshold=args.threshold,
+                               min_delta_us=args.min_delta_us,
+                               min_samples=args.min_samples)
+    if args.json:
+        print(json.dumps({
+            "percentile": q,
+            "threshold": args.threshold,
+            "min_delta_us": args.min_delta_us,
+            "methods_compared": sorted(set(base) & set(new)),
+            "regressions": [r.to_dict() for r in regs],
+        }, indent=2))
+    else:
+        sys.stdout.write(_diff.render_report(base, new, regs, q))
+    return 1 if regs else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
