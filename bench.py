@@ -46,6 +46,10 @@ def _phase_enabled(name: str) -> bool:
     return not PHASES or name in PHASES
 BASELINE_GBPS = 2.3       # reference docs/cn/benchmark.md:104 plateau
 HEADLINE_SIZE = 1 << 20
+# small-message baseline: the 64B row of the r03 Python tpu:// sweep
+# (pre fastpath-stack; BENCH_r03.json) — the qps the latency work is
+# measured against
+BASELINE_64B_QPS = 1692.0
 
 # (payload bytes, threads, calls per thread)
 SWEEP = [
@@ -160,7 +164,8 @@ def bench_multi_threaded_echo():
 def bench_tpu_sweep():
     """rdma_performance analog: payload sweep over the tpu:// transport.
 
-    Returns the 1MB aggregate bandwidth in GB/s (the headline)."""
+    Returns (1MB aggregate GB/s — the headline, 64B sweep qps — the
+    small-message summary metric)."""
     from brpc_tpu.proto import echo_pb2
     from brpc_tpu.rpc import Channel, ChannelOptions, Stub
     from brpc_tpu.tpu.transport import (g_tunnel_ack_credits,
@@ -197,6 +202,7 @@ def bench_tpu_sweep():
         _run_calls(stub, echo_pb2, b"\xab" * max(s for s, _, _ in SWEEP),
                    1, 1)
         by_size = {}
+        qps_by_size = {}
         bulk_copied = bulk_borrowed = 0
         for size, threads, calls in SWEEP:
             payload = b"\xab" * size
@@ -205,6 +211,7 @@ def bench_tpu_sweep():
             wall, lats = _run_calls(stub, echo_pb2, payload, threads, calls)
             gbps = 2 * size * len(lats) / wall / 1e9
             by_size[size] = gbps
+            qps_by_size[size] = len(lats) / wall
             if size == 16 << 20:
                 bulk_borrowed = g_tunnel_borrowed_bytes.get_value() - b0[0]
                 bulk_copied = g_tunnel_copied_bytes.get_value() - b0[1]
@@ -268,7 +275,7 @@ def bench_tpu_sweep():
                 f"peak borrowed-outstanding ({peak} blocks) reached the "
                 f"{DEFAULT_BLOCK_COUNT}-block window — bodies are no "
                 f"longer being claimed mid-message")
-        return headline
+        return headline, qps_by_size.get(64, 0.0)
     finally:
         srv.close()
 
@@ -848,7 +855,9 @@ def main() -> None:
         bench_hybrid_native()
     if _phase_enabled("batch"):
         bench_batch_lane()
-    py_1mb = bench_tpu_sweep() if _phase_enabled("shm") else None
+    py_1mb = py_64b_qps = None
+    if _phase_enabled("shm"):
+        py_1mb, py_64b_qps = bench_tpu_sweep()
     if os.environ.get("BENCH_SKIP_DEVICE") != "1" and \
             _phase_enabled("device"):
         try:
@@ -887,6 +896,15 @@ def main() -> None:
         "unit": "GB/s",
         "vs_baseline": round(headline / BASELINE_GBPS, 3),
     }))
+    # small-message summary line: the Python tpu:// sweep's 64B row (the
+    # fastpath stack's target metric; vs_baseline is against BENCH_r03)
+    if py_64b_qps:
+        print(json.dumps({
+            "metric": "echo_64b_qps",
+            "value": round(py_64b_qps, 1),
+            "unit": "qps",
+            "vs_baseline": round(py_64b_qps / BASELINE_64B_QPS, 3),
+        }))
 
 
 if __name__ == "__main__":

@@ -504,3 +504,125 @@ def rule_metric_flag_hygiene(pkg: Package) -> List[Finding]:
                 f"flags.get({name!r}) has no define() anywhere in the "
                 f"package — first read raises FlagError at runtime"))
     return out
+
+
+# --------------------------------------------------------------------------
+# Rule 7: bounded-spin
+# --------------------------------------------------------------------------
+# The wakeup discipline (PR 9): a busy-wait loop — one whose body never
+# parks (no sleep/wait/select/poll/acquire/join/recv/accept/get call) —
+# burns the core, and under the GIL it holds off the very thread it is
+# waiting on. Every such loop must either be bounded by a spin budget
+# (reference an identifier containing "spin" or "budget", i.e. route
+# through fiber.wakeup.AdaptiveSpin) or demonstrably make progress on its
+# own condition (assign/mutate a name its test reads, or exit via
+# break/return/raise).
+
+_PARK_TOKENS = ("sleep", "wait", "select", "poll", "acquire", "join",
+                "recv", "accept", "park", "get", "read")
+
+
+def _while_identifiers(node: ast.While) -> Set[str]:
+    names: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            names.add(sub.id.lower())
+        elif isinstance(sub, ast.Attribute):
+            names.add(sub.attr.lower())
+    return names
+
+
+def _test_refs(test) -> Set[str]:
+    """Names + attribute chains the loop condition reads."""
+    refs: Set[str] = set()
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Name):
+            refs.add(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            chain = attr_chain(sub)
+            if chain is not None:
+                refs.add(chain)
+    return refs
+
+
+def _target_refs(target) -> Set[str]:
+    refs: Set[str] = set()
+    for sub in ast.walk(target):
+        if isinstance(sub, ast.Name):
+            refs.add(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            chain = attr_chain(sub)
+            if chain is not None:
+                refs.add(chain)
+        elif isinstance(sub, ast.Subscript):
+            chain = attr_chain(sub.value)
+            if chain is not None:
+                refs.add(chain)
+    return refs
+
+
+@register_rule(
+    "bounded-spin",
+    "busy-wait loops (no park/sleep/select call in the body) must be "
+    "bounded by a spin budget or make progress on their own condition")
+def rule_bounded_spin(pkg: Package) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in pkg.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.While):
+                continue
+            parks = False
+            exits = False
+            progress: Set[str] = set()
+            test_refs = _test_refs(node.test)
+            for sub in ast.walk(node.test):
+                # a consuming I/O call in the condition itself
+                # (`while os.read(fd, n):` pipe drains) is not a busy-wait
+                if isinstance(sub, ast.Call):
+                    name = attr_chain(sub.func)
+                    if name is not None and any(
+                            t in name.split(".")[-1].lower()
+                            for t in _PARK_TOKENS):
+                        parks = True
+            for child in node.body:
+                for sub in ast.walk(child):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        break  # nested defs don't run in the loop body
+                    if isinstance(sub, (ast.Break, ast.Return, ast.Raise)):
+                        exits = True
+                    elif isinstance(sub, ast.Call):
+                        name = attr_chain(sub.func)
+                        if name is not None:
+                            last = name.split(".")[-1].lower()
+                            if any(t in last for t in _PARK_TOKENS):
+                                parks = True
+                            if isinstance(sub.func, ast.Attribute):
+                                # a mutating call on a tested receiver
+                                # (`while q: q.popleft()`) is progress
+                                recv = attr_chain(sub.func.value)
+                                if recv is not None:
+                                    progress.add(recv)
+                    elif isinstance(sub, (ast.Assign, ast.AugAssign,
+                                          ast.AnnAssign)):
+                        targets = (sub.targets
+                                   if isinstance(sub, ast.Assign)
+                                   else [sub.target])
+                        for t in targets:
+                            progress |= _target_refs(t)
+                    elif isinstance(sub, ast.NamedExpr):
+                        progress |= _target_refs(sub.target)
+                    elif isinstance(sub, ast.For):
+                        progress |= _target_refs(sub.target)
+            if parks or exits or (progress & test_refs):
+                continue
+            idents = _while_identifiers(node)
+            if any("spin" in i or "budget" in i for i in idents):
+                continue
+            out.append(Finding(
+                "bounded-spin", sf.rel, node.lineno,
+                "busy-wait loop: the body neither parks "
+                "(sleep/wait/select/...), exits, nor advances the loop "
+                "condition — bound it with a fiber.wakeup.AdaptiveSpin "
+                "budget or park between probes"))
+    return out

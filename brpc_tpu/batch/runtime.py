@@ -279,6 +279,12 @@ class _BoundBatchedMethod:
         if rc != 0:
             cntl.set_failed(rc, f"batch queue {self.queue.name} over "
                                 f"capacity")
+        elif getattr(getattr(cntl, "_srv_socket", None),
+                     "priority_lane", False):
+            # latency-sensitive lane: a request arriving on the tpu
+            # tunnel's priority sub-stream is exempt from batch_wait —
+            # flush whatever this admission formed immediately
+            self.queue.flush("priority")
         return None
 
 

@@ -352,6 +352,14 @@ def tpu_service(server, http: HttpMessage):
                 state["server_endpoints"].append(ep.state_dict())
             except Exception:  # endpoint torn down mid-snapshot
                 continue
+    # small-message fastpath observability: adaptive spin budgets, the
+    # coalesced-doorbell / priority-lane counters (already in pri_lane),
+    # and run-to-completion per-method classification
+    from brpc_tpu.fiber import wakeup as _wakeup
+    from brpc_tpu.rpc import run_to_completion as _rtc
+
+    state["wakeup"] = _wakeup.stats()
+    state["rtc"] = _rtc.stats()
     if http.query.get("format", "") == "json":
         return 200, CONTENT_JSON, json.dumps(state, indent=2) + "\n"
 
@@ -393,6 +401,34 @@ def tpu_service(server, http: HttpMessage):
             f"bg_healing={h['bg_healing']} "
             f"breaker_isolated={h['breaker_isolated']} "
             f"last_error={h['last_error'] or '-'}")
+    pri = state.get("pri_lane", {})
+    lines.append("")
+    lines.append("== priority lane / doorbells ==")
+    lines.append(
+        f"pri_tx={pri.get('tx_frames', 0)} pri_rx={pri.get('rx_frames', 0)} "
+        f"pri_bytes={pri.get('bytes', 0)} "
+        f"doorbell_flushes={pri.get('doorbell_flushes', 0)} "
+        f"doorbell_frames={pri.get('doorbell_frames', 0)}")
+    wk = state.get("wakeup", {})
+    lines.append("")
+    lines.append("== wakeup (adaptive spin) ==")
+    lines.append(
+        f"spins={wk.get('spins', 0)} wins={wk.get('spin_wins', 0)} "
+        f"losses={wk.get('spin_losses', 0)} parks={wk.get('parks', 0)}")
+    for name, budget in sorted(wk.get("budgets", {}).items()):
+        lines.append(f"  {name}: budget={budget}")
+    rtc = state.get("rtc", {})
+    lines.append("")
+    lines.append("== run-to-completion ==")
+    lines.append(
+        f"inline_requests={rtc.get('inline_requests', 0)} "
+        f"inline_responses={rtc.get('inline_responses', 0)} "
+        f"demotions={rtc.get('demotions', 0)}")
+    for name, m in sorted(rtc.get("methods", {}).items()):
+        lines.append(
+            f"  {name}: ema_us={m['ema_us']} samples={m['samples']} "
+            f"hits={m['hits']} demoted={m['demoted']} "
+            f"opted_in={m['opted_in']}")
     return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
 
 

@@ -14,6 +14,12 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from brpc_tpu.fiber import wakeup as _wakeup
+
+# shared spin budget for every butex wait (per-site granularity lives in
+# the contention table; the spin policy adapts to the process-wide mix)
+_spin = _wakeup.get_spin("butex")
+
 # contention bookkeeping (reference bthread/mutex.cpp:63-80 contention
 # profiler): per-site wait counts + total wait time, sampled cheaply —
 # only waits that actually blocked are recorded
@@ -62,6 +68,14 @@ class Butex:
         Returns immediately if the value already differs (the lost-wakeup
         guard that makes the butex protocol race-free).
         """
+        # spin-then-park: probe the word lock-free before paying for the
+        # condition variable (racy read is safe — the locked re-check below
+        # is still the authority; a spin "win" only short-circuits a park)
+        if self._value != expected:
+            return True
+        if (timeout is None or timeout > 0) and _spin.spin(
+                lambda: self._value != expected):
+            return True
         with self._cond:
             if self._value != expected:
                 return True

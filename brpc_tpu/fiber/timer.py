@@ -53,9 +53,15 @@ class TimerThread:
         entry = _Entry(abstime, next(self._seq), fn, args)
         with self._cond:
             self._ensure_started()
+            # wake the sleeper only when this deadline becomes the new
+            # head: an RPC-timeout timer landing behind the current head
+            # (the overwhelmingly common case) must not cost a thread
+            # wakeup per call — the sleeper's timed wait already covers it
+            wake = not self._heap or abstime < self._heap[0].deadline
             heapq.heappush(self._heap, entry)
             self._entries[entry.seq] = entry
-            self._cond.notify()
+            if wake:
+                self._cond.notify()
         return entry.seq
 
     def unschedule(self, timer_id: int) -> bool:

@@ -210,3 +210,26 @@ tpu_reconnect_window_s = define(
     "total time budget a background tunnel heal keeps retrying before "
     "giving up (the next RPC or health probe re-dials on demand)",
     validator=_positive)
+tpu_doorbell_coalesce_us = define(
+    "tpu_doorbell_coalesce_us", 200,
+    "coalesce FT_ACK credit returns and small response frames produced "
+    "inside one poll-batch round into a single ctrl-socket doorbell, "
+    "bounded by this many microseconds of added hold latency "
+    "(0 = legacy per-message writes)", validator=_non_negative)
+rtc_enable = define(
+    "rtc_enable", True,
+    "run-to-completion dispatch: execute cheap, small-payload methods "
+    "directly on the cut-loop thread instead of the queue->worker hop",
+    reloadable=True)
+rtc_budget_us = define(
+    "rtc_budget_us", 2000,
+    "a run-to-completion handler exceeding this wall budget demotes its "
+    "method back to queued dispatch (sticky)", validator=_positive)
+rtc_cheap_us = define(
+    "rtc_cheap_us", 1000,
+    "auto-classify a method as inline-eligible once its observed "
+    "execution-time EMA sits below this", validator=_positive)
+rtc_max_body = define(
+    "rtc_max_body", 16 * 1024,
+    "only messages with bodies at most this large (and no attachment) "
+    "ride the run-to-completion path", validator=_positive)

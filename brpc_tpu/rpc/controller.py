@@ -10,6 +10,7 @@ verification (the controller.cpp:1059-1066 race guard).
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Optional
 
@@ -19,6 +20,7 @@ from brpc_tpu.fiber.timer import timer_add, timer_del
 from brpc_tpu.policy import compress as _compress
 from brpc_tpu.proto import rpc_meta_pb2
 from brpc_tpu.rpc import errors
+from brpc_tpu.trace import span as _span
 
 
 class Controller:
@@ -100,8 +102,6 @@ class Controller:
         self._done = done
         self._start_us = time.perf_counter_ns() // 1000
         if self.span is None:
-            from brpc_tpu.trace import span as _span
-
             self.span = _span.start_client_span(
                 method.service_name, method.method_name,
                 parent=_span.current_span())
@@ -130,8 +130,6 @@ class Controller:
         if self.span is not None:
             # the span is "current" across dial + write so the transport
             # (tpu:// credit stalls, healer dials) annotates this attempt
-            from brpc_tpu.trace import span as _span
-
             prev_span = _span.set_current(self.span)
             try:
                 self._issue_rpc_inner()
@@ -347,9 +345,7 @@ class Controller:
         _cid.id_about_to_destroy(cid)
         _cid.id_unlock_and_destroy(cid)
         if done is not None:
-            import threading as _threading
-
-            if getattr(_threading.current_thread(), "brpc_no_user_code",
+            if getattr(threading.current_thread(), "brpc_no_user_code",
                        False):
                 # completing inline on an I/O/poller thread: user code may
                 # block (even issue sync RPCs) — hand it to a fiber worker

@@ -24,6 +24,7 @@ import selectors
 import threading
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from brpc_tpu.fiber import wakeup as _wakeup
 from brpc_tpu.metrics.reducer import Adder
 
 log = logging.getLogger("brpc_tpu.event_dispatcher")
@@ -41,6 +42,10 @@ class EventDispatcher:
         self._stopped = False
         self.events_dispatched = Adder()
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        # run-to-completion executes framework completions on this thread;
+        # user callbacks reaching a completion path here must be offloaded
+        # (controller._finish_locked checks this mark)
+        self._thread.brpc_no_user_code = True
         self._thread.start()
 
     # ------------------------------------------------------------------- api
@@ -154,11 +159,35 @@ class EventDispatcher:
             pass
 
     def _run(self) -> None:
+        # Load-adaptive select timeout: after a quantum that delivered real
+        # events, the next frame of the conversation is usually already in
+        # flight — burn a few zero-timeout selects (each one a syscall, so
+        # the GIL is released per probe) before decaying back to the 1s
+        # park. The spin budget adapts: probes that see events grow it,
+        # dry probe runs shrink it toward the floor, so an idle loop (or a
+        # single-core box where the peer needs this CPU) spends its life
+        # parked exactly as before.
+        # small ceiling: each probe is a syscall, and a dry decay from the
+        # cap must stay well under the 1ms scale the spin is trying to win
+        spin = _wakeup.get_spin(f"dispatcher:{self._thread.name}",
+                                initial=8, floor=1, ceiling=64)
+        spin_left = 0
         while not self._stopped:
+            spinning = spin_left > 0
             try:
-                events = self._selector.select(timeout=1.0)
+                events = self._selector.select(
+                    timeout=0.0 if spinning else 1.0)
             except OSError:
                 continue
+            if spinning:
+                spin_left -= 1
+                _wakeup.g_wakeup_spins.put(1)
+            if events:
+                if spinning:
+                    spin.note_win()
+                spin_left = spin.budget
+            elif spinning and spin_left == 0:
+                spin.note_loss()
             for key, mask in events:
                 if key.fd == self._wake_r:
                     try:

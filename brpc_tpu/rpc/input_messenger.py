@@ -29,6 +29,7 @@ from brpc_tpu.rpc.protocol import (
     list_protocols,
 )
 from brpc_tpu.rpc import errors
+from brpc_tpu.rpc import run_to_completion as _rtc
 from brpc_tpu.rpc.socket import Socket
 
 _tls = threading.local()
@@ -190,8 +191,11 @@ class InputMessenger:
                         # serial parse loop; the handler only enqueues to
                         # per-stream queues
                         _process_one(msg, server)
+                    elif _rtc.dispatch(msg, server):
+                        pass  # ran to completion on this thread
                     else:
-                        runtime.start_background(_process_one, msg, server)
+                        runtime.start_background(
+                            _rtc.observe_queued, msg, server)
         finally:
             if batch_hook is not None:
                 batch_hook.cut_batch_end()

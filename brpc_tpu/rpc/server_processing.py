@@ -20,6 +20,7 @@ from brpc_tpu.policy import compress as _compress
 from brpc_tpu.proto import rpc_meta_pb2
 from brpc_tpu.rpc import errors
 from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.trace import span as _tspan
 
 # requests rejected because their client timeout budget was already spent
 # before the handler could run (server-side deadline enforcement)
@@ -66,6 +67,22 @@ def process_rpc_request(protocol, msg, server) -> None:
     sock = msg.socket
     if server is None:
         return  # request arrived on a client-only connection: drop
+    # the common trpc_std request — no auth/interceptor/dump hooks, no
+    # attachment/checksum/compress/stream policy riding the meta — takes
+    # the slim lane: FastServerController + a slotted done instead of the
+    # full Controller and two closures per request. Anything unusual (or a
+    # method-lookup miss, which may route to the master service) falls
+    # through to the complete pipeline below.
+    if (protocol.name == "trpc_std"
+            and server.options.auth is None
+            and server.options.interceptor is None
+            and server.rpc_dumper is None
+            and not meta.attachment_size
+            and not meta.checksum
+            and meta.compress_type == _compress.COMPRESS_NONE
+            and not meta.HasField("stream_settings")
+            and _process_request_slim(protocol, msg, server, meta)):
+        return
     server.requests_processed.put(1)
     cntl = Controller.server_controller(server, sock, meta)
     from brpc_tpu.trace import span as _span
@@ -281,6 +298,218 @@ def process_rpc_request(protocol, msg, server) -> None:
     except BaseException:
         _settle(errors.EINTERNAL)
         raise
+
+
+# ===================================================================== slim
+# Python-socket counterpart of the native fast path below: same admission
+# state machine, same FastServerController, but responses pack through
+# protocol.pack_response and write to the request's socket. This is the
+# lane every small tpu:// / TCP echo takes (queued AND run-to-completion
+# dispatch both land here via process_rpc_request), so its per-request
+# constant factor is the server side of the small-message latency budget.
+
+_slim_collector = None
+
+
+def _slim_error(protocol, sock, meta, span, code: int, text: str = "") -> None:
+    if span is not None:  # rejected requests must reach /rpcz too
+        span.end(code)
+    _send_response(protocol, sock, meta, code,
+                   text or errors.error_text(code),
+                   b"", b"", _compress.COMPRESS_NONE)
+
+
+class _SlimDone:
+    """The slim path's `done` callable + stats settlement in one slotted
+    object (the full path builds two closures and two flag cells per
+    request; this allocates once)."""
+
+    __slots__ = ("protocol", "sock", "meta", "cntl", "entry", "server",
+                 "start_us", "responded", "settled")
+
+    def __init__(self, protocol, sock, meta, cntl, entry, server, start_us):
+        self.protocol = protocol
+        self.sock = sock
+        self.meta = meta
+        self.cntl = cntl
+        self.entry = entry
+        self.server = server
+        self.start_us = start_us
+        self.responded = False
+        self.settled = False
+
+    def __call__(self, response=None) -> None:
+        if self.responded:
+            return
+        self.responded = True
+        cntl = self.cntl
+        span = cntl.span
+        t_resp = time.perf_counter_ns() if span is not None else 0
+        payload_out = b""
+        ct = cntl.compress_type
+        if response is not None and not cntl.failed():
+            payload_out = _compress.compress(response.SerializeToString(),
+                                             ct)
+        code = cntl._error_code
+        meta = self.meta
+        rmeta = rpc_meta_pb2.RpcMeta()
+        rmeta.response.error_code = code
+        if code != errors.OK:
+            rmeta.response.error_text = cntl._error_text
+        rmeta.correlation_id = meta.correlation_id
+        rmeta.attempt_version = meta.attempt_version
+        rmeta.compress_type = ct
+        packet = self.protocol.pack_response(
+            rmeta, payload_out, cntl.response_attachment, checksum=False)
+        if span is not None:
+            # span "current" across the write: the tunnel's send pipeline
+            # (credit stalls, quanta) annotates THIS request
+            prev = _tspan.set_current(span)
+            try:
+                self.sock.write(packet)
+            finally:
+                _tspan.set_current(prev)
+            span.response_size = (len(payload_out)
+                                  + len(cntl.response_attachment or b""))
+            el = (time.perf_counter_ns() - t_resp) / 1000.0
+            ph = span.phases
+            el -= ph.get("send_us", 0.0) + ph.get("credit_wait_us", 0.0)
+            span.add_phase("respond_us", max(0.0, el))
+        else:
+            self.sock.write(packet)
+        self.settle(code)
+
+    def settle(self, error_code: int) -> None:
+        if self.settled:
+            return
+        self.settled = True
+        self.entry.on_response(
+            time.perf_counter_ns() // 1000 - self.start_us, error_code)
+        self.server.sub_concurrency()
+        span = self.cntl.span
+        if span is not None:
+            span.end(error_code)
+
+
+def _process_request_slim(protocol, msg, server, meta) -> bool:
+    """Returns False (before touching any request state) when the caller
+    should take the full pipeline instead — only a method-lookup miss,
+    which may involve the master service's catch-all routing."""
+    global _slim_collector
+    req = meta.request
+    svc = req.service_name
+    meth = req.method_name
+    entry = server._method_cache.get((svc, meth))
+    if entry is None:
+        service = server.find_service(svc)
+        entry = service.find_method(meth) if service is not None else None
+        if entry is None:
+            return False
+        server._method_cache[(svc, meth)] = entry
+    sock = msg.socket
+    server.requests_processed.put(1)
+
+    if _slim_collector is None:  # cache the module: tests swap _collector
+        from brpc_tpu.metrics import collector as _slim_collector_
+
+        _slim_collector = _slim_collector_
+    coll = _slim_collector._collector or _slim_collector.global_collector()
+    # span pre-gate (fast-path idiom): an untraced request during a
+    # standing collector denial can never be sampled — skip the sampling
+    # walk entirely
+    if req.trace_id == 0 and time.monotonic() < coll._deny_until:
+        span = None
+    else:
+        span = _tspan.start_server_span(meta, svc, meth,
+                                        peer=str(sock.remote))
+        if span is not None:
+            arrival = getattr(msg, "arrival", 0.0)
+            if arrival:
+                q_us = max(0.0, (time.monotonic() - arrival) * 1e6)
+                span.start_mono_us -= q_us
+                span.start_us -= q_us
+                span.add_phase("queue_us", q_us)
+
+    if not server.is_running:
+        _slim_error(protocol, sock, meta, span, errors.ELOGOFF)
+        return True
+    if not server.add_concurrency():
+        _slim_error(protocol, sock, meta, span, errors.ELIMIT,
+                    "server max_concurrency reached")
+        return True
+    start_us = time.perf_counter_ns() // 1000
+    budget_ms = int(req.timeout_ms or 0)
+    deadline_mono = 0.0
+    if budget_ms > 0:
+        arrival = getattr(msg, "arrival", 0.0)
+        if arrival:
+            if (time.monotonic() - arrival) * 1000.0 >= budget_ms:
+                g_server_deadline_expired.put(1)
+                server.sub_concurrency()
+                _slim_error(protocol, sock, meta, span, errors.ERPCTIMEDOUT,
+                            f"request deadline ({budget_ms}ms) already "
+                            f"spent before dispatch")
+                return True
+            deadline_mono = arrival + budget_ms / 1000.0
+    if not entry.on_request():
+        # a known method shed by its limit stays ELIMIT (never re-routed
+        # to the master service — full-pipeline contract)
+        server.sub_concurrency()
+        _slim_error(protocol, sock, meta, span, errors.ELIMIT,
+                    "method concurrency limit")
+        return True
+
+    cntl = FastServerController(server, sock, svc, meth, req.log_id,
+                                budget_ms)
+    cntl.span = span
+    cntl._srv_socket = sock  # batch runtime reads this (priority flush)
+    if deadline_mono:
+        cntl.deadline_mono = deadline_mono
+    done = _SlimDone(protocol, sock, meta, cntl, entry, server, start_us)
+
+    try:
+        t_parse = time.perf_counter_ns() if span is not None else 0
+        body = msg.body
+        if span is not None:
+            span.request_size = len(body)
+        data = body.tobytes()
+        body.clear()  # drop block refs now, not at message GC
+        try:
+            request = entry.request_class()
+            request.ParseFromString(data)
+        except Exception as e:
+            cntl.set_failed(errors.EREQUEST, f"parse request: {e}")
+            done()
+            return True
+        if span is not None:
+            span.add_phase(
+                "parse_us", (time.perf_counter_ns() - t_parse) / 1000.0)
+        prev_span = _tspan.set_current(span)
+        t_exec = time.perf_counter_ns() if span is not None else 0
+        ex0 = _other_marks(span)
+        try:
+            if _fault.hit("rpc.handler.crash") is not None:
+                raise RuntimeError("fault injected handler crash")
+            _fault.maybe_sleep(
+                _fault.hit("rpc.handler.delay", method=meth))
+            ret = entry.fn(cntl, request, done)
+        except Exception as e:  # user bug -> EINTERNAL, not a dead conn
+            cntl.set_failed(errors.EINTERNAL, f"method raised: {e}")
+            ret = None
+        finally:
+            _tspan.set_current(prev_span)
+            if span is not None:
+                el = (time.perf_counter_ns() - t_exec) / 1000.0
+                span.add_phase(
+                    "execute_us",
+                    max(0.0, el - (_other_marks(span) - ex0)))
+        if not done.responded and (ret is not None or cntl.failed()):
+            done(ret)
+        # else: user code kept `done` for async completion
+    except BaseException:
+        done.settle(errors.EINTERNAL)
+        raise
+    return True
 
 
 # ===================================================================== fast

@@ -246,9 +246,11 @@ def stream_create(options: Optional[StreamOptions] = None) -> int:
 def stream_accept(cntl, options: Optional[StreamOptions] = None) -> int:
     """Server side: accept inside the method handler (StreamAccept,
     stream.h:121). Binding completes when the response goes out."""
-    settings = cntl._srv_meta.stream_settings
-    if settings.stream_id == 0:
+    meta = getattr(cntl, "_srv_meta", None)  # slim/fast controllers carry
+    # no meta pb — those paths only take requests without stream settings
+    if meta is None or meta.stream_settings.stream_id == 0:
         raise ValueError("request carries no stream settings")
+    settings = meta.stream_settings
     stream = Stream(options or StreamOptions())
     stream.stream_id = _stream_pool.insert(stream)
     stream.bind(cntl._srv_socket, settings.stream_id,

@@ -51,12 +51,14 @@ from multiprocessing import shared_memory as _shm
 from typing import Dict, List, Optional, Tuple
 
 from brpc_tpu import fault as _fault
+from brpc_tpu import flags as _flags
 from brpc_tpu.analysis import runtime_check as _rc
 from brpc_tpu.analysis.markers import poller_context
 from brpc_tpu.butil.endpoint import EndPoint
 from brpc_tpu.butil.iobuf import IOBuf
 from brpc_tpu.butil.resource_pool import VersionedPool
 from brpc_tpu.fiber import call_id as _cid
+from brpc_tpu.fiber import wakeup as _wakeup
 from brpc_tpu.metrics.reducer import Adder
 from brpc_tpu.rpc import errors
 from brpc_tpu.rpc.protocol import (
@@ -77,6 +79,13 @@ FT_HELLO_ACK = 2  # server -> client: my pool + my device
 FT_DATA = 3       # ordered chunk of the tunnel byte stream
 FT_ACK = 4        # return block credits
 FT_BYE = 5        # orderly shutdown
+# priority lane (v3): a SECOND framed sub-stream on the same ctrl socket.
+# Frame-granular interleave with FT_DATA is safe — the receiver demuxes by
+# frame type into a separate virtual socket — so a small latency-sensitive
+# packet never queues behind the quanta of a 16MB main-lane send. Only
+# correlation-addressed traffic (TRPC magic) may ride it; order-sensitive
+# byte streams (HTTP, TSTR stream frames) stay on the main lane.
+FT_DATA_PRI = 6
 
 # every stream frame carries the tunnel's window generation (epoch): after
 # a re-handshake rebuilds the pools, DATA/ACK frames still in flight from
@@ -120,7 +129,11 @@ MAX_SEGS_PER_FRAME = 32       # wire-format cap on segments per DATA frame
 # blocks, and a large message never parks waiting for more credits than
 # one frame needs (the old loop demanded up to MAX_SEGS_PER_FRAME at once)
 SEND_PIPELINE_SEGS = 4
-HANDSHAKE_VERSION = 2  # v2: epoch (window generation) in HELLO/DATA/ACK
+# v2: epoch (window generation) in HELLO/DATA/ACK
+# v3: FT_DATA_PRI priority lane + coalesced doorbells (both gated on the
+#     peer advertising >= 3, so a v2 peer never sees a frame type or
+#     batched write pattern it cannot parse)
+HANDSHAKE_VERSION = 3
 
 # device-fabric traffic counters (the /vars view of the "ICI NIC");
 # named Adders self-expose, so /vars and the Prometheus exporter see them
@@ -149,6 +162,14 @@ g_tunnel_credit_stalls = Adder("g_tunnel_credit_stalls")
 g_tunnel_credit_wait_us = Adder("g_tunnel_credit_wait_us")
 # in-band server-side window rebuilds (client re-HELLO on a live bootstrap)
 g_tunnel_epoch_restarts = Adder("g_tunnel_epoch_restarts")
+# priority lane + coalesced doorbell accounting (v3 fast path)
+g_tunnel_pri_tx_frames = Adder("g_tunnel_pri_tx_frames")
+g_tunnel_pri_rx_frames = Adder("g_tunnel_pri_rx_frames")
+g_tunnel_pri_bytes = Adder("g_tunnel_pri_bytes")
+# doorbell flushes = combined ctrl writes; frames = response frames they
+# carried (frames/flushes is the coalescing ratio, like the ACK one)
+g_tunnel_doorbell_flushes = Adder("g_tunnel_doorbell_flushes")
+g_tunnel_doorbell_frames = Adder("g_tunnel_doorbell_frames")
 
 # chaos injection points threaded through this module (see fault/core.py
 # and docs/fault-injection.md; zero-cost while disarmed)
@@ -211,7 +232,16 @@ def _cleanup_owned_pools() -> None:
             seg.close()
             seg.unlink()
         except Exception:
-            pass
+            # segment already gone: drop the stale tracker registration
+            # too, or its shutdown scan warns about a "leaked" segment it
+            # can no longer find
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister(
+                    "/" + name.lstrip("/"), "shared_memory")
+            except Exception:
+                pass
         _owned_pools.discard(name)
 
 
@@ -307,19 +337,32 @@ class BlockPool:
 
     # ----------------------------------------------------------------- close
     def close(self) -> None:
-        """Request close. With borrowed views still exported the unmap is
-        deferred to the last drop_export (an shm segment cannot unmap under
-        a live buffer export); the name is unlinked at exit regardless."""
+        """Request close. The segment NAME is unlinked right here — POSIX
+        keeps the mapping alive for every process that already attached, and
+        unlinking eagerly removes this process's resource_tracker
+        registration while the interpreter is still healthy (a deferred
+        unlink raced tracker shutdown and left a spurious leaked-shm
+        UserWarning in bench tails). Only the unmap is deferred to the last
+        drop_export (an shm segment cannot unmap under a live buffer
+        export)."""
         with self._lock:
             if self._closed or self._close_pending:
                 return
             self._close_pending = True
             busy = self._exports > 0
+        self._unlink_name()
         if busy:
             with _deferred_close_lock:
                 _deferred_close_pools.append(self)
             return
         self._try_finish_close()
+
+    def _unlink_name(self) -> None:
+        try:
+            self._shm.unlink()   # also unregisters from resource_tracker
+        except Exception:
+            pass
+        _owned_pools.discard(self.name)
 
     def _try_finish_close(self) -> None:
         with self._lock:
@@ -339,14 +382,15 @@ class BlockPool:
             pass
         with self._lock:
             self._closed = True
-        try:
-            self._shm.unlink()
-        except Exception:
-            pass
-        _owned_pools.discard(self.name)
         with _deferred_close_lock:
             if self in _deferred_close_pools:
                 _deferred_close_pools.remove(self)
+
+
+# shared adaptive spin budgets for the transport's two hot waits (see
+# fiber/wakeup.py): credit-window refills and endpoint-ready handshakes
+_window_spin = _wakeup.get_spin("tpu_window")
+_ready_spin = _wakeup.get_spin("tpu_ready", initial=16, ceiling=512)
 
 
 class PeerWindow:
@@ -370,6 +414,11 @@ class PeerWindow:
     def acquire(self, want: int, timeout: float = 30.0) -> Optional[List[int]]:
         """Return 1..want block indices, parking until at least one is free.
         None on timeout/close (window wedged — peer stopped consuming)."""
+        if not self._free and not self._closed:
+            # adaptive spin before the locked park: under streaming-parse
+            # credit return the refill usually lands within the spin
+            # budget, and winning here skips the full park/notify round
+            _window_spin.spin(lambda: bool(self._free) or self._closed)
         deadline = _time.monotonic() + timeout
         with self._cond:
             while not self._free and not self._closed:
@@ -562,6 +611,22 @@ class TpuEndpoint:
         # the /tpu builtin reads them racily, which is fine for a gauge)
         self.credit_stalls = 0
         self.credit_wait_us = 0.0
+        # v3 fast path: peer's handshake version gates the priority lane
+        # and doorbell coalescing (0 until HELLO/HELLO_ACK lands)
+        self.peer_version = 0
+        self._pri_vsock: Optional["TpuTransportSocket"] = None
+        self._pri_lock = threading.Lock()
+        # coalesced doorbell: small response frames produced ON the cut
+        # thread while its batch bracket is open are banked here and flush
+        # with the batch's FT_ACK as one ctrl write (_db_thread is the cut
+        # thread's ident while a bracket is open, 0 otherwise)
+        self._db_frames: List[tuple] = []   # [(views, total), ...]
+        self._db_thread = 0
+        self._db_first_ns = 0
+        self.pri_tx_frames = 0
+        self.pri_rx_frames = 0
+        self.doorbell_flushes = 0
+        self.doorbell_frames = 0
         self.vsock = TpuTransportSocket(self)
         # coalesce credit returns across a dispatcher poll batch: the
         # messenger brackets its cut loop with these hooks on both the
@@ -613,6 +678,11 @@ class TpuEndpoint:
             "out_bytes": self.vsock.out_bytes,
             "in_messages": self.vsock.in_messages,
             "out_messages": self.vsock.out_messages,
+            "peer_version": self.peer_version,
+            "pri_tx_frames": self.pri_tx_frames,
+            "pri_rx_frames": self.pri_rx_frames,
+            "doorbell_flushes": self.doorbell_flushes,
+            "doorbell_frames": self.doorbell_frames,
         }
 
     # --------------------------------------------------------------- handshake
@@ -643,6 +713,7 @@ class TpuEndpoint:
             self.window = None
             self.inline_only = True
         self.peer_ordinal = int(info.get("ordinal", -1))
+        self.peer_version = int(info.get("v", 1))
 
     def on_hello(self, body: bytes) -> None:
         """Server side: attach the client's pool, reply with ours. The ACK
@@ -718,8 +789,13 @@ class TpuEndpoint:
         g_tunnel_epoch_restarts.put(1)
         with self._ack_lock:
             self._ack_pending.clear()
+            self._db_frames.clear()
         self.vsock.pending_body = None
         self.vsock.read_buf.clear()   # releases old borrowed views
+        pv = self._pri_vsock
+        if pv is not None:
+            pv.pending_body = None
+            pv.read_buf.clear()
         if self.window is not None:
             self.window.close()
             self.window = None
@@ -749,20 +825,59 @@ class TpuEndpoint:
         if span is not None:
             t0 = _time.monotonic_ns()
             cw0 = span.phases.get("credit_wait_us", 0.0)
-        with self._send_lock:
-            if self._failed:
-                return errors.EFAILEDSOCKET
+        # v3 small-packet fast lane: a whole correlation-addressed TRPC
+        # packet at most INLINE_MAX must never queue behind the quanta of a
+        # bulk main-lane send. Only TRPC magic qualifies — order-sensitive
+        # byte streams (TSTR frames, h2) stay on the main lane.
+        pri_ok = (0 < total <= INLINE_MAX and self.peer_version >= 3
+                  and len(views[0]) >= 4 and bytes(views[0][:4]) == b"TRPC")
+        if pri_ok and self._db_thread == threading.get_ident():
+            # produced ON the cut thread inside its open batch bracket
+            # (run-to-completion response): bank the frame — it flushes
+            # with the batch's FT_ACK as ONE coalesced doorbell write
+            hold_us = int(_flags.get("tpu_doorbell_coalesce_us"))
+            if hold_us > 0:
+                now = _time.monotonic_ns()
+                if not self._db_frames:
+                    self._db_first_ns = now
+                self._db_frames.append((views, total))
+                self.vsock.out_bytes += total
+                if (now - self._db_first_ns) // 1000 >= hold_us:
+                    # age bound: a long cut batch must not hold responses
+                    # past the configured latency budget — flush frames
+                    # early, keep banking credits to batch end
+                    frames, self._db_frames = self._db_frames, []
+                    self._db_first_ns = 0
+                    return self._flush_doorbell(frames, [])
+                return 0
+        on_main_lane = True
+        if pri_ok:
+            on_main_lane = self._send_lock.acquire(blocking=False)
+        else:
+            self._send_lock.acquire()
+        if on_main_lane:
             try:
-                if total <= INLINE_MAX or self.window is None:
-                    rc, partial = self._send_inline(views, total)
-                else:
-                    rc, partial = self._send_blocks(views, total, span)
-            except Exception:
                 if self._failed:
-                    # fail() released the shm mapping under our feet
-                    # (concurrent BYE/teardown) — a clean error, not a crash
                     return errors.EFAILEDSOCKET
-                raise
+                try:
+                    if total <= INLINE_MAX or self.window is None:
+                        rc, partial = self._send_inline(views, total)
+                    else:
+                        rc, partial = self._send_blocks(views, total, span)
+                except Exception:
+                    if self._failed:
+                        # fail() released the shm mapping under our feet
+                        # (concurrent BYE/teardown) — a clean error, not a
+                        # crash
+                        return errors.EFAILEDSOCKET
+                    raise
+            finally:
+                self._send_lock.release()
+        else:
+            # main lane mid-bulk-send: divert to the priority sub-stream
+            # (frame-granular interleave on the ctrl socket is safe — the
+            # receiver demuxes FT_DATA_PRI into a separate virtual socket)
+            rc, partial = self._send_pri(views, total), False
         if rc == 0:
             self.vsock.out_bytes += total
         if span is not None:
@@ -806,6 +921,20 @@ class TpuEndpoint:
     def _send_inline(self, views, total: int):
         """Returns (rc, partial): partial=True once any frame was posted."""
         if total == 0:
+            return 0, False
+        if total <= INLINE_MAX:
+            # single-frame case: build one contiguous bytes object instead
+            # of an IOBuf — a small echo pays this framing cost twice per
+            # RPC and bytes.join beats block-list assembly at these sizes
+            frame = b"".join(
+                (struct.pack(CTRL_HDR, CTRL_MAGIC, FT_DATA,
+                             DATA_BODY_HDR_SIZE + total),
+                 struct.pack(DATA_BODY_HDR, self.epoch, total, 0),
+                 *views))
+            rc = self._write_data_frame(frame)
+            if rc != 0:
+                return rc, False
+            g_tunnel_out_bytes.put(total)
             return 0, False
         # chunk so a huge DCN-fallback payload can't build one giant frame
         chunk = DEFAULT_BLOCK_SIZE
@@ -927,6 +1056,24 @@ class TpuEndpoint:
                            sent=sent, total=total)
         return 0, False
 
+    def _send_pri(self, views, total: int) -> int:
+        """Post one whole small packet as a single FT_DATA_PRI frame.
+        Needs no _send_lock: the ctrl socket's write path appends a whole
+        call's views atomically, so pri frames interleave with main-lane
+        FT_DATA at frame granularity only."""
+        frame = b"".join(
+            (struct.pack(CTRL_HDR, CTRL_MAGIC, FT_DATA_PRI,
+                         DATA_BODY_HDR_SIZE + total),
+             struct.pack(DATA_BODY_HDR, self.epoch, total, 0),
+             *views))
+        rc = self._write_data_frame(frame)
+        if rc == 0:
+            self.pri_tx_frames += 1
+            g_tunnel_pri_tx_frames.put(1)
+            g_tunnel_pri_bytes.put(total)
+            g_tunnel_out_bytes.put(total)
+        return rc
+
     # -------------------------------------------------------------- recv path
     @poller_context
     def on_data(self, body: IOBuf) -> None:
@@ -1014,6 +1161,56 @@ class TpuEndpoint:
         g_tunnel_in_bytes.put(got)
         self._messenger.cut_messages(vsock)
 
+    def _pri_lane_sock(self) -> "TpuTransportSocket":
+        """Lazy second virtual socket backing the priority sub-stream.
+        Correlation ids are SHARED with the main lane (a response may
+        arrive on either), so both vsocks resolve one pending set."""
+        pv = self._pri_vsock
+        if pv is None:
+            with self._pri_lock:
+                pv = self._pri_vsock
+                if pv is None:
+                    pv = TpuTransportSocket(self)
+                    pv._pending_ids = self.vsock._pending_ids
+                    pv._pending_lock = self.vsock._pending_lock
+                    pv.priority_lane = True
+                    pv.remote = self.vsock.remote
+                    pv.owner_server = self.vsock.owner_server
+                    pv.cut_batch_hook = self
+                    self._pri_vsock = pv
+        return pv
+
+    @poller_context
+    def on_data_pri(self, body: IOBuf) -> None:
+        """Priority-lane receive: inline-only frames each carrying one
+        whole small packet, demuxed into a separate virtual socket so
+        their parse never waits behind the main lane's partially-arrived
+        bulk body."""
+        if self._failed:
+            return
+        if len(body) < DATA_BODY_HDR_SIZE:
+            self.fail(errors.EREQUEST, "short PRI frame")
+            return
+        epoch, inline_len, nsegs = struct.unpack(
+            DATA_BODY_HDR, body.fetch(DATA_BODY_HDR_SIZE))
+        body.pop_front(DATA_BODY_HDR_SIZE)
+        if epoch != self.epoch:
+            g_tunnel_stale_epoch_frames.put(1)
+            return
+        if nsegs or len(body) < inline_len:
+            # pri frames are inline-only by contract: block refs here mean
+            # a desynced or hostile peer
+            self.fail(errors.EREQUEST, "malformed PRI frame")
+            return
+        pv = self._pri_lane_sock()
+        body.cutn_into(inline_len, pv.read_buf)
+        pv.in_bytes += inline_len
+        pv.last_active = _time.monotonic()
+        self.pri_rx_frames += 1
+        g_tunnel_pri_rx_frames.put(1)
+        g_tunnel_in_bytes.put(inline_len)
+        self._messenger.cut_messages(pv)
+
     # ------------------------------------------------- deferred batched acks
     def _credit_released(self, idx: int, pool: BlockPool, epoch: int) -> None:
         """Release hook of one borrowed block: runs exactly once, whenever
@@ -1058,20 +1255,73 @@ class TpuEndpoint:
             self.fail(errors.EFAILEDSOCKET, "ACK write failed")
 
     # messenger cut-batch bracket: while a poll batch is being cut, credit
-    # returns accumulate and flush as ONE FT_ACK at batch end
+    # returns accumulate and flush as ONE FT_ACK at batch end; responses
+    # the batch's run-to-completion handlers produced (banked in
+    # send_packet) ride the same doorbell write
     def cut_batch_begin(self) -> None:
         with self._ack_lock:
             self._ack_hold += 1
+            if self._ack_hold == 1:
+                # only this thread can match the ident in send_packet, so
+                # the racy read there is safe
+                self._db_thread = threading.get_ident()
 
     @poller_context
     def cut_batch_end(self) -> None:
         with self._ack_lock:
             self._ack_hold -= 1
-            if self._ack_hold > 0 or self._failed or not self._ack_pending:
+            if self._ack_hold > 0:
+                return
+            self._db_thread = 0
+            frames = self._db_frames
+            if frames:
+                self._db_frames = []
+                self._db_first_ns = 0
+            if self._failed or (not self._ack_pending and not frames):
                 return
             acks = self._ack_pending
             self._ack_pending = []
-        self._write_ack(acks)
+        if frames:
+            self._flush_doorbell(frames, acks)
+        else:
+            # ack-only batch: the legacy single-FT_ACK path (keeps the
+            # tpu.ack.* fault hooks meaningful)
+            self._write_ack(acks)
+
+    @poller_context
+    def _flush_doorbell(self, frames, acks) -> int:
+        """ONE ctrl write carrying the batch's banked response frames (as
+        FT_DATA_PRI) plus its FT_ACK — the coalesced doorbell. Under load
+        a poll batch of N cheap requests costs one syscall instead of
+        N responses + 1 ack."""
+        parts = []
+        for views, total in frames:
+            parts.append(struct.pack(CTRL_HDR, CTRL_MAGIC, FT_DATA_PRI,
+                                     DATA_BODY_HDR_SIZE + total))
+            parts.append(struct.pack(DATA_BODY_HDR, self.epoch, total, 0))
+            parts.extend(views)
+            self.pri_tx_frames += 1
+            g_tunnel_pri_tx_frames.put(1)
+            g_tunnel_pri_bytes.put(total)
+            g_tunnel_out_bytes.put(total)
+        if acks:
+            body = struct.pack(f"!{len(acks) + 2}I", self.epoch, len(acks),
+                               *acks)
+            parts.append(struct.pack(CTRL_HDR, CTRL_MAGIC, FT_ACK,
+                                     len(body)))
+            parts.append(body)
+            g_tunnel_ack_frames.put(1)
+            g_tunnel_ack_credits.put(len(acks))
+        self.doorbell_flushes += 1
+        self.doorbell_frames += len(frames) + (1 if acks else 0)
+        g_tunnel_doorbell_flushes.put(1)
+        g_tunnel_doorbell_frames.put(len(frames) + (1 if acks else 0))
+        rc = self._write_data_frame(b"".join(parts))
+        if rc != 0:
+            # banked responses (and credits) never reached the peer: the
+            # stream contract is broken for both lanes
+            self.fail(errors.EFAILEDSOCKET, "doorbell flush failed")
+        return rc
 
     @poller_context
     def cut_body_complete(self) -> None:
@@ -1111,10 +1361,19 @@ class TpuEndpoint:
         self.ready.set()
         # credits pending return die with the tunnel: the peer's window is
         # being torn down too, and an ACK write would race the ctrl close
+        # (banked doorbell responses die the same way — their calls are
+        # errored through the shared pending-id set below)
         with self._ack_lock:
             self._ack_pending.clear()
+            self._db_frames.clear()
         if not from_vsock:
             self.vsock.set_failed(code, reason)
+        pv = self._pri_vsock
+        if pv is not None:
+            if not pv.failed:
+                pv.set_failed(code, reason)
+            pv.pending_body = None
+            pv.read_buf.clear()
         # drop un-parsed borrowed views NOW (outside any ack lock): their
         # release hooks fire inside this clear() — each exactly once, with
         # _failed already set so no ACK is queued — which usually leaves the
@@ -1185,7 +1444,7 @@ class TpuCtrlProtocol(Protocol):
         magic, ftype, blen = struct.unpack(CTRL_HDR, buf.fetch(CTRL_HDR_SIZE))
         if magic != CTRL_MAGIC:
             return PARSE_TRY_OTHERS, None
-        if not (FT_HELLO <= ftype <= FT_BYE) or blen > self.MAX_FRAME:
+        if not (FT_HELLO <= ftype <= FT_DATA_PRI) or blen > self.MAX_FRAME:
             return PARSE_BAD, None
         if len(buf) < CTRL_HDR_SIZE + blen:
             from brpc_tpu.rpc.protocol import (PendingBodyCursor,
@@ -1233,6 +1492,8 @@ class TpuCtrlProtocol(Protocol):
             ep.on_hello_ack(msg.body.tobytes())
         elif ftype == FT_DATA:
             ep.on_data(msg.body)   # IOBuf: payload bytes are never flattened
+        elif ftype == FT_DATA_PRI:
+            ep.on_data_pri(msg.body)
         elif ftype == FT_ACK:
             ep.on_ack(msg.body.tobytes())
         elif ftype == FT_BYE:
@@ -1360,6 +1621,9 @@ class TunnelHealer:
         boot._on_readable = messenger.make_on_readable(boot)
         boot.register_read()
         endpoint.send_hello()
+        # spin-then-park: on a loopback/shm peer the HELLO_ACK round trip
+        # is microseconds — winning the spin skips an Event park/notify
+        _ready_spin.spin(endpoint.ready.is_set)
         if not endpoint.ready.wait(timeout):
             endpoint.fail(errors.EHOSTDOWN, "tpu handshake timeout")
             raise ConnectionError(f"tpu handshake with {ep} timed out")
@@ -1445,6 +1709,13 @@ def tunnel_state() -> dict:
         healers = dict(_healers)
     out = {
         "borrowed_peak_blocks": borrowed_peak_blocks(),
+        "pri_lane": {
+            "tx_frames": g_tunnel_pri_tx_frames.get_value(),
+            "rx_frames": g_tunnel_pri_rx_frames.get_value(),
+            "bytes": g_tunnel_pri_bytes.get_value(),
+            "doorbell_flushes": g_tunnel_doorbell_flushes.get_value(),
+            "doorbell_frames": g_tunnel_doorbell_frames.get_value(),
+        },
         "client_endpoints": [],
         "healers": [],
     }

@@ -367,15 +367,34 @@ def _gen_id() -> int:
     return random.getrandbits(63) | 1
 
 
+_collector_mod = None
+
+
 def _sampled() -> bool:
+    # the selection ratio rides the PROCESS-WIDE sampling budget shared
+    # with rpc_dump etc. (metrics/collector.py, reference bvar Collector)
+    global _collector_mod
+    if _collector_mod is None:  # lazy: collector imports flags at load
+        from brpc_tpu.metrics import collector as _collector_mod_
+
+        _collector_mod = _collector_mod_
+    # cache the MODULE, not the instance: tests (and a future reset) swap
+    # collector._collector, and a cached instance would gate on the dead
+    # one's budget
+    coll = _collector_mod._collector
+    if coll is None:
+        coll = _collector_mod.global_collector()
+    # pre-gate on the collector's standing denial window (`_deny_until` is
+    # a documented contract, collector.py): during a denial no draw can
+    # succeed, so skip the ratio draw entirely — this runs once per
+    # untraced RPC on BOTH roles and the saved microseconds are measurable
+    # at small-echo rates
+    if time.monotonic() < coll._deny_until:
+        return False
     ratio = _flags.get("rpcz_sample_ratio")
     if ratio < 1.0 and random.random() >= ratio:
         return False
-    # the selection ratio rides the PROCESS-WIDE sampling budget shared
-    # with rpc_dump etc. (metrics/collector.py, reference bvar Collector)
-    from brpc_tpu.metrics.collector import global_collector
-
-    return global_collector().ask_to_be_sampled()
+    return coll.ask_to_be_sampled()
 
 
 def start_client_span(service: str, method: str,

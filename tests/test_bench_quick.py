@@ -35,11 +35,35 @@ def bench_run():
 def test_headline_json(bench_run):
     lines = [l for l in bench_run.stdout.splitlines()
              if l.startswith("{")]
-    assert len(lines) == 1, bench_run.stdout
+    assert len(lines) == 2, bench_run.stdout
     headline = json.loads(lines[0])
     assert headline["metric"] == "echo_1mb_framework_bandwidth"
     assert headline["unit"] == "GB/s"
     assert headline["value"] > 0, headline
+
+
+def test_small_message_qps_json(bench_run):
+    """The shm sweep must emit the 64B small-message summary line."""
+    rows = [json.loads(l) for l in bench_run.stdout.splitlines()
+            if l.startswith("{")]
+    small = [r for r in rows if r["metric"] == "echo_64b_qps"]
+    assert len(small) == 1, bench_run.stdout
+    assert small[0]["unit"] == "qps"
+    assert small[0]["value"] > 0, small[0]
+    assert small[0]["vs_baseline"] > 0, small[0]
+
+
+def test_rtc_lane_activates_on_shm_sweep(bench_run):
+    """The run-to-completion lane must engage for the sweep's small
+    echoes: the bench server's exit report shows inline hits on Echo."""
+    rtc = [l for l in bench_run.stderr.splitlines()
+           if l.startswith("# rtc ")]
+    assert rtc, bench_run.stderr[-2000:]
+    line = rtc[0]
+    assert "EchoService.Echo" in line, line
+    hits = int(line.split("EchoService.Echo:hits=")[1].split(",")[0])
+    assert hits > 0, line
+    assert "demoted=0" in line, line
 
 
 def test_only_shm_phase_ran(bench_run):

@@ -18,8 +18,10 @@ from brpc_tpu.rpc.channel import MethodDescriptor, RpcError
 from brpc_tpu.rpc import errors
 from brpc_tpu.rpc.native_transport import dataplane_available
 
-pytestmark = pytest.mark.skipif(not dataplane_available(),
-                                reason="native engine unavailable")
+# applied per-test (not module-wide): the pure-Python fastpath tests at the
+# bottom of this file run regardless of whether the native engine built
+needs_native = pytest.mark.skipif(not dataplane_available(),
+                                  reason="native engine unavailable")
 
 SVC = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
 
@@ -51,6 +53,7 @@ def native_server():
     srv.join()
 
 
+@needs_native
 def test_fast_sync_echo(native_server):
     ch = _fast_channel(native_server.listen_endpoint())
     stub = Stub(ch, SVC)
@@ -59,6 +62,7 @@ def test_fast_sync_echo(native_server):
         assert r.message == f"m{i}"
 
 
+@needs_native
 def test_fast_attachment_roundtrip(native_server):
     ch = _fast_channel(native_server.listen_endpoint())
     stub = Stub(ch, SVC)
@@ -69,6 +73,7 @@ def test_fast_attachment_roundtrip(native_server):
     assert cntl.latency_us > 0
 
 
+@needs_native
 def test_fast_big_response_via_donated_frame(native_server):
     # >=64KB responses arrive as donated EV_FRAME buffers; the fast record
     # must still complete through the frame path
@@ -80,6 +85,7 @@ def test_fast_big_response_via_donated_frame(native_server):
     assert cntl.response_attachment == b"\xee" * (256 << 10)
 
 
+@needs_native
 def test_fast_unknown_service_and_method(native_server):
     ch = _fast_channel(native_server.listen_endpoint())
     md = MethodDescriptor("NoSuchService", "Echo",
@@ -94,6 +100,7 @@ def test_fast_unknown_service_and_method(native_server):
     assert ei.value.error_code == errors.ENOMETHOD
 
 
+@needs_native
 def test_fast_async_done(native_server):
     ch = _fast_channel(native_server.listen_endpoint())
     stub = Stub(ch, SVC)
@@ -112,6 +119,7 @@ def test_fast_async_done(native_server):
     assert seen["resp"].message == "async"
 
 
+@needs_native
 def test_fast_async_big_response_pointer_record(native_server):
     # an ASYNC caller with a >=64KB response: the donated EV_FRAME rides
     # dp_poll_packed as a POINTER record (not inlined) and must complete
@@ -135,6 +143,7 @@ def test_fast_async_big_response_pointer_record(native_server):
     assert seen["cntl"].join(1)  # post-completion join returns immediately
 
 
+@needs_native
 def test_fast_concurrent_joiners_share_one_event():
     # two threads joining one in-flight async call must BOTH wake (the
     # lazy join-event install is guarded; a lost event would hang one)
@@ -173,6 +182,7 @@ def test_fast_concurrent_joiners_share_one_event():
         srv.join(timeout=5)
 
 
+@needs_native
 def test_fast_timeout_held_done(native_server):
     held = []
 
@@ -201,6 +211,7 @@ def test_fast_timeout_held_done(native_server):
         srv2.join()
 
 
+@needs_native
 def test_fast_async_timeout_swept(native_server):
     held = []
 
@@ -235,6 +246,7 @@ def test_fast_async_timeout_swept(native_server):
         srv2.join()
 
 
+@needs_native
 def test_fast_elogoff_after_stop(native_server):
     ch = _fast_channel(native_server.listen_endpoint(), max_retry=0)
     stub = Stub(ch, SVC)
@@ -247,6 +259,7 @@ def test_fast_elogoff_after_stop(native_server):
     assert ei.value.error_code in (errors.ELOGOFF, errors.EFAILEDSOCKET)
 
 
+@needs_native
 def test_fast_method_concurrency_limit():
     release = threading.Event()
     entered = threading.Event()
@@ -291,6 +304,7 @@ def test_fast_method_concurrency_limit():
         srv.join()
 
 
+@needs_native
 def test_fast_trace_propagation(native_server):
     # force sampling so the fast path carries trace ids natively
     from brpc_tpu import flags
@@ -322,6 +336,7 @@ def test_fast_trace_propagation(native_server):
         coll._fixed_rate = old_rate
 
 
+@needs_native
 def test_slow_path_call_on_fast_conn(native_server):
     # a full-Controller call (backup_request forces the slow path) on a
     # fast conn completes through the EV_RESPONSE reconstruct route
@@ -334,6 +349,7 @@ def test_slow_path_call_on_fast_conn(native_server):
     assert r.message == "slowlane"
 
 
+@needs_native
 def test_native_echo_admission_and_stats():
     srv = Server(ServerOptions(native_dataplane=True))
     srv.add_service(EchoImpl())
@@ -364,6 +380,7 @@ def test_native_echo_admission_and_stats():
         srv.join()
 
 
+@needs_native
 def test_fast_usercode_inline_server():
     srv = Server(ServerOptions(native_dataplane=True, usercode_inline=True))
     srv.add_service(EchoImpl())
@@ -379,6 +396,7 @@ def test_fast_usercode_inline_server():
         srv.join()
 
 
+@needs_native
 def test_fast_zero_copy_tunnel_response():
     # tpu:// native tunnel: big responses arrive as zero-copy pool views
     # (EV_RESPONSE_ZC) and the credits must flow back (repeat calls would
@@ -402,6 +420,7 @@ def test_fast_zero_copy_tunnel_response():
         srv.join()
 
 
+@needs_native
 def test_native_echo_zero_copy_tunnel():
     srv = Server(ServerOptions(native_dataplane=True))
     srv.add_service(EchoImpl())
@@ -423,6 +442,7 @@ def test_native_echo_zero_copy_tunnel():
         srv.join()
 
 
+@needs_native
 def test_zero_copy_rejections_return_credits():
     # admission-rejected bulk requests must still ACK the donated blocks;
     # a credit leak would wedge the tunnel after ~window/block_count
@@ -463,6 +483,7 @@ def test_zero_copy_rejections_return_credits():
         srv.join()
 
 
+@needs_native
 def test_fast_retry_after_server_restart():
     srv = Server(ServerOptions(native_dataplane=True))
     srv.add_service(EchoImpl())
@@ -476,3 +497,269 @@ def test_fast_retry_after_server_restart():
     # server gone: calls fail fast (retry budget burns on dead conns)
     with pytest.raises(RpcError):
         stub.Echo(echo_pb2.EchoRequest(message="b"))
+
+
+# ======================================================================
+# Pure-Python small-message fastpath (no native engine required): the
+# adaptive spin wakeup, run-to-completion dispatch, coalesced doorbells,
+# and the priority lane. These pin the PR's latency-stack semantics.
+# ======================================================================
+
+from brpc_tpu import flags as _flags  # noqa: E402
+from brpc_tpu.fiber import wakeup as _wakeup  # noqa: E402
+from brpc_tpu.rpc import run_to_completion as _rtc  # noqa: E402
+
+
+@pytest.fixture()
+def rtc_reset():
+    _rtc._reset_for_test()
+    yield
+    _rtc._reset_for_test()
+
+
+# ------------------------------------------------------- adaptive spin
+class TestAdaptiveSpin:
+    def test_budget_grows_on_wins(self):
+        s = _wakeup.AdaptiveSpin("t_grow", initial=8, floor=1, ceiling=64)
+        assert s.spin(lambda: True)
+        assert s.budget > 8
+        for _ in range(20):
+            s.spin(lambda: True)
+        assert s.budget == 64  # clamped at the ceiling
+
+    def test_budget_shrinks_to_floor_on_losses(self):
+        s = _wakeup.AdaptiveSpin("t_shrink", initial=64, floor=2,
+                                 ceiling=256)
+        for _ in range(20):
+            assert not s.spin(lambda: False)
+        assert s.budget == 2  # halved down to the probe floor
+
+    def test_win_inside_window_observed_mid_spin(self):
+        s = _wakeup.AdaptiveSpin("t_mid", initial=32, floor=1, ceiling=64)
+        calls = {"n": 0}
+
+        def ready():
+            calls["n"] += 1
+            return calls["n"] >= 5  # wake arrives on the 5th probe
+
+        assert s.spin(ready)
+        assert s.budget > 32
+
+    def test_stats_counters_move(self):
+        before = _wakeup.stats()
+        s = _wakeup.get_spin("t_stats", initial=4)
+        s.spin(lambda: True)
+        s.spin(lambda: False)
+        after = _wakeup.stats()
+        assert after["spin_wins"] >= before["spin_wins"] + 1
+        assert after["spin_losses"] >= before["spin_losses"] + 1
+        assert after["parks"] >= before["parks"] + 1
+        assert "t_stats" in after["budgets"]
+
+
+# -------------------------------------------------- run-to-completion
+class TestRunToCompletion:
+    def test_auto_classified_cheap_method_runs_inline(self, rtc_reset):
+        srv = Server(ServerOptions())
+        srv.add_service(EchoImpl())
+        srv.start("127.0.0.1:0")
+        try:
+            ch = Channel(ChannelOptions(protocol="trpc_std",
+                                        timeout_ms=5000))
+            ch.init(str(srv.listen_endpoint()))
+            stub = Stub(ch, SVC)
+            # MIN_SAMPLES queued observations feed the EMA, then the
+            # method is classified cheap and later calls run inline
+            for i in range(_rtc.MIN_SAMPLES + 12):
+                r = stub.Echo(echo_pb2.EchoRequest(message=f"c{i}"))
+                assert r.message == f"c{i}"
+            st = _rtc.method_stats()["EchoService.Echo"]
+            assert st["samples"] >= _rtc.MIN_SAMPLES
+            assert st["hits"] > 0, st
+            assert not st["demoted"], st
+            assert 0 < st["ema_us"] < float(_flags.get("rtc_cheap_us")), st
+        finally:
+            srv.stop()
+            srv.join()
+
+    def test_slow_opted_in_handler_is_demoted(self, rtc_reset):
+        budget_s = float(_flags.get("rtc_budget_us")) / 1e6
+
+        class SlowEcho(Service):
+            DESCRIPTOR = SVC
+
+            @_rtc.inline_eligible
+            def Echo(self, cntl, request, done):
+                time.sleep(budget_s * 2)  # always overruns the budget
+                return echo_pb2.EchoResponse(message=request.message)
+
+        srv = Server(ServerOptions())
+        srv.add_service(SlowEcho())
+        srv.start("127.0.0.1:0")
+        try:
+            ch = Channel(ChannelOptions(protocol="trpc_std",
+                                        timeout_ms=10000))
+            ch.init(str(srv.listen_endpoint()))
+            stub = Stub(ch, SVC)
+            for i in range(_rtc.DEMOTE_AFTER + 3):
+                stub.Echo(echo_pb2.EchoRequest(message=f"s{i}"))
+            st = _rtc.method_stats()["EchoService.Echo"]
+            assert st["opted_in"], st
+            # ran inline (opt-in skips the warmup), overran, got demoted
+            assert st["hits"] >= _rtc.DEMOTE_AFTER, st
+            assert st["demoted"], st
+            assert st["demotions"] >= 1, st
+            # demotion is sticky: later calls still answer correctly
+            r = stub.Echo(echo_pb2.EchoRequest(message="after"))
+            assert r.message == "after"
+            assert _rtc.method_stats()["EchoService.Echo"]["hits"] \
+                == st["hits"]
+        finally:
+            srv.stop()
+            srv.join()
+
+    def test_small_echo_identical_on_both_dispatch_paths(self, rtc_reset):
+        """The run-to-completion lane must be semantically invisible:
+        the same small echo answers identically with rtc on and off."""
+        srv = Server(ServerOptions())
+        srv.add_service(EchoImpl())
+        srv.start("127.0.0.1:0")
+        try:
+            results = {}
+            for enabled in (True, False):
+                _flags.set_flag("rtc_enable", enabled)
+                _rtc._reset_for_test()
+                ch = Channel(ChannelOptions(protocol="trpc_std",
+                                            timeout_ms=5000))
+                ch.init(str(srv.listen_endpoint()))
+                stub = Stub(ch, SVC)
+                out = []
+                for i in range(_rtc.MIN_SAMPLES + 4):
+                    cntl = Controller()
+                    cntl.request_attachment = b"att-%d" % i
+                    r = stub.Echo(echo_pb2.EchoRequest(
+                        message=f"d{i}", payload=b"\x7f" * 64),
+                        controller=cntl)
+                    out.append((r.message, bytes(r.payload),
+                                bytes(cntl.response_attachment)))
+                results[enabled] = out
+            assert results[True] == results[False]
+            # and the disabled run really stayed off the inline lane
+            assert _rtc.method_stats().get(
+                "EchoService.Echo", {}).get("hits", 0) == 0
+        finally:
+            _flags.set_flag("rtc_enable", True)
+            srv.stop()
+            srv.join()
+
+
+# --------------------------------------- doorbells + credits (ledger)
+class TestDoorbellCoalescing:
+    def test_coalesced_doorbells_return_all_credits(self, rtc_reset):
+        """BRPC_TPU_CHECK-armed run over the shm tunnel: banked doorbell
+        responses and batched FT_ACKs must balance the credit window at
+        teardown (a leaked credit wedges the tunnel; the ledger turns it
+        into a hard failure)."""
+        from brpc_tpu.analysis import runtime_check as rc
+        from brpc_tpu.tpu import transport as T
+
+        was_active = rc.ACTIVE
+        rc.activate()
+        srv = Server(ServerOptions())
+        srv.add_service(EchoImpl())
+        srv.start("tpu://127.0.0.1:0/0")
+        try:
+            ch = Channel(ChannelOptions(protocol="trpc_std",
+                                        timeout_ms=20000))
+            ch.init(str(srv.listen_endpoint()))
+            stub = Stub(ch, SVC)
+            flushes0 = T.g_tunnel_doorbell_flushes.get_value()
+            # small echoes: past MIN_SAMPLES the server answers on the
+            # cut thread and its responses ride coalesced doorbells
+            for i in range(_rtc.MIN_SAMPLES + 24):
+                r = stub.Echo(echo_pb2.EchoRequest(message=f"db{i}"))
+                assert r.message == f"db{i}"
+            # bulk calls force pool borrows, so ACK credits must cycle
+            blob = b"\x3c" * (256 << 10)
+            for _ in range(4):
+                cntl = Controller()
+                cntl.request_attachment = blob
+                stub.Echo(echo_pb2.EchoRequest(message="bulk"),
+                          controller=cntl)
+                assert cntl.response_attachment == blob
+            assert T.g_tunnel_doorbell_flushes.get_value() > flushes0
+            assert T.g_tunnel_doorbell_frames.get_value() >= \
+                T.g_tunnel_doorbell_flushes.get_value()
+        finally:
+            srv.stop()
+            srv.join()
+            try:
+                # every borrowed block returned, every credit released
+                rc.ledger.assert_balanced(drain=T._sweep_deferred_pools)
+            finally:
+                if was_active:
+                    rc.activate()
+                else:
+                    rc.deactivate()
+
+
+# ------------------------------------------------------ priority lane
+class TestPriorityLane:
+    def test_small_calls_survive_concurrent_16mb_send(self, rtc_reset):
+        """While a 16MB echo streams through the tunnel, small calls keep
+        completing (the priority lane / coalesced doorbells bypass the
+        bulk send) and the tunnel reports priority-lane traffic."""
+        from brpc_tpu.tpu import transport as T
+
+        srv = Server(ServerOptions())
+        srv.add_service(EchoImpl())
+        srv.start("tpu://127.0.0.1:0/0")
+        try:
+            ch = Channel(ChannelOptions(protocol="trpc_std",
+                                        timeout_ms=60000))
+            ch.init(str(srv.listen_endpoint()))
+            stub = Stub(ch, SVC)
+            for i in range(_rtc.MIN_SAMPLES + 2):  # warm the rtc lane
+                stub.Echo(echo_pb2.EchoRequest(message=f"w{i}"))
+
+            pri0 = (T.g_tunnel_pri_tx_frames.get_value()
+                    + T.g_tunnel_doorbell_frames.get_value())
+            blob = b"\x99" * (16 << 20)
+            bulk_err = []
+
+            def bulk():
+                try:
+                    cntl = Controller()
+                    cntl.request_attachment = blob
+                    stub.Echo(echo_pb2.EchoRequest(message="bulk"),
+                              controller=cntl)
+                    assert cntl.response_attachment == blob
+                except BaseException as e:  # surfaced after join
+                    bulk_err.append(e)
+
+            t = threading.Thread(target=bulk)
+            t.start()
+            lats = []
+            deadline = time.monotonic() + 30
+            # in-process loopback can finish the bulk echo quickly: keep
+            # going until a few small calls have landed either way
+            while ((t.is_alive() or len(lats) < 5)
+                   and time.monotonic() < deadline):
+                t0 = time.perf_counter()
+                r = stub.Echo(echo_pb2.EchoRequest(message="tiny"))
+                lats.append(time.perf_counter() - t0)
+                assert r.message == "tiny"
+            t.join(60)
+            assert not t.is_alive(), "16MB echo wedged"
+            assert not bulk_err, bulk_err
+            assert lats, "no small call completed during the bulk send"
+            lats.sort()
+            # generous single-core bound: the lane exists so a small call
+            # never waits out the whole 16MB transfer
+            assert lats[len(lats) // 2] < 5.0, lats
+            pri1 = (T.g_tunnel_pri_tx_frames.get_value()
+                    + T.g_tunnel_doorbell_frames.get_value())
+            assert pri1 > pri0, "no priority-lane/doorbell frame moved"
+        finally:
+            srv.stop()
+            srv.join()

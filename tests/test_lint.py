@@ -15,7 +15,7 @@ REPO = Path(__file__).resolve().parent.parent
 
 EXPECTED_RULES = {
     "no-blocking-in-poller", "acquire-release", "monotonic-clock",
-    "lock-order", "version-guard", "metric-flag-hygiene",
+    "lock-order", "version-guard", "metric-flag-hygiene", "bounded-spin",
 }
 
 
@@ -373,6 +373,70 @@ class TestMetricFlagHygiene:
             def f():
                 return flags.get("my_knob")
             """}, rules=["metric-flag-hygiene"])
+        assert res.clean
+
+
+# ------------------------------------------------------------ bounded-spin
+class TestBoundedSpin:
+    def test_pure_busy_wait_fires(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/foo.py": """\
+            def wait_ready(self):
+                while not self._ready:
+                    pass
+            """}, rules=["bounded-spin"])
+        assert len(res.findings) == 1
+        assert res.findings[0].line == 2
+
+    def test_spin_budget_reference_passes(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/foo.py": """\
+            def wait_ready(self, spin):
+                while not self._ready:
+                    if not spin.spin():
+                        break
+            """}, rules=["bounded-spin"])
+        assert res.clean
+
+    def test_park_in_body_passes(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/foo.py": """\
+            import time
+            def wait_ready(self):
+                while not self._ready:
+                    time.sleep(0.001)
+            """}, rules=["bounded-spin"])
+        assert res.clean
+
+    def test_consuming_call_in_condition_passes(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/foo.py": """\
+            import os
+            def drain(self, fd):
+                while os.read(fd, 4096):
+                    pass
+            """}, rules=["bounded-spin"])
+        assert res.clean
+
+    def test_progress_on_condition_variable_passes(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/foo.py": """\
+            def count(self, n):
+                while n > 0:
+                    n -= 1
+            """}, rules=["bounded-spin"])
+        assert res.clean
+
+    def test_mutating_receiver_passes(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/foo.py": """\
+            def drain(self, q):
+                while q:
+                    q.popleft()
+            """}, rules=["bounded-spin"])
+        assert res.clean
+
+    def test_break_in_body_passes(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/foo.py": """\
+            def probe(self):
+                while True:
+                    if self._ready:
+                        break
+            """}, rules=["bounded-spin"])
         assert res.clean
 
 

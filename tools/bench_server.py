@@ -178,6 +178,20 @@ def main(argv=None):
         pass
     server.stop()
     server.join()
+    # run-to-completion activation report: which methods ran inline on
+    # the cut loop this run (bench.py surfaces this on its stderr; the
+    # test_bench_quick smoke asserts the lane engaged on the shm sweep)
+    from brpc_tpu.rpc import run_to_completion as _rtc
+
+    st = _rtc.stats()
+    per_method = " ".join(
+        f"{name}:hits={m['hits']},ema_us={m['ema_us']},"
+        f"demoted={int(m['demoted'])}"
+        for name, m in st["methods"].items()) or "no-methods"
+    print(f"# rtc inline_requests={st['inline_requests']} "
+          f"inline_responses={st['inline_responses']} "
+          f"demotions={st['demotions']} {per_method}",
+          file=sys.stderr, flush=True)
     return 0
 
 
