@@ -842,7 +842,162 @@ def bench_device_probe():
           f"echo): {gbps:.1f} GB/s", file=sys.stderr)
 
 
+def _task_cpu_s(native_tid: int) -> float:
+    """One thread's OS CPU seconds (utime+stime) from /proc; 0.0 when the
+    thread is gone or the platform has no /proc."""
+    try:
+        with open(f"/proc/self/task/{native_tid}/stat") as f:
+            parts = f.read().rsplit(")", 1)[1].split()
+        return (int(parts[11]) + int(parts[12])) / os.sysconf("SC_CLK_TCK")
+    except (OSError, ValueError, IndexError):
+        return 0.0
+
+
+def bench_profile():
+    """``bench.py --profile``: the echo lane under the whole-process
+    sampler. Server and client live in THIS process (one sampler sees
+    both sides of the GIL), a ProfileSession wraps the measured loop, and
+    the output is (a) the folded-stack artifact (BENCH_PROFILE_OUT, for
+    tools/flame_view.py + tools/prof_diff.py) and (b) the per-call CPU
+    budget table: each thread's OS-measured CPU (time.thread_time for the
+    client workers, /proc task stats for the framework threads)
+    distributed over span phases in proportion to that thread's
+    cpu-classified samples, then checked against time.process_time() —
+    the check fails if thread tracking loses part of the process."""
+    from brpc_tpu.profiling.sampler import ProfileSession
+    from brpc_tpu.proto import echo_pb2
+    from brpc_tpu.rpc import Channel, ChannelOptions, Server, Service, Stub
+
+    ECHO = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+    class EchoImpl(Service):
+        DESCRIPTOR = ECHO
+
+        def Echo(self, cntl, request, done):
+            return echo_pb2.EchoResponse(message=request.message,
+                                         payload=request.payload)
+
+    out_path = os.environ.get(
+        "BENCH_PROFILE_OUT", os.path.join(REPO, "BENCH_PROFILE.folded"))
+    hz = 200.0
+    threads = 4
+    calls = 300 if QUICK else 2500
+    server = Server().add_service(EchoImpl()).start("tpu://127.0.0.1:0/0")
+    try:
+        ch = Channel(ChannelOptions(protocol="trpc_std", timeout_ms=30000))
+        ch.init(str(server.listen_endpoint()))
+        stub = Stub(ch, ECHO)
+        payload = b"\xab" * 4096
+        _run_calls(stub, echo_pb2, payload, threads, 30)  # warmup
+
+        # like _run_calls, but each worker reports its own thread CPU
+        # (the workers are gone from /proc by the time the session stops)
+        lat_per_thread = [[] for _ in range(threads)]
+        worker_cpu = {}  # thread ident -> thread_time seconds
+        failures = []
+        barrier = threading.Barrier(threads + 1)
+
+        def worker(idx):
+            req = echo_pb2.EchoRequest(message="b", payload=payload)
+            lats = lat_per_thread[idx]
+            barrier.wait()
+            try:
+                for _ in range(calls):
+                    t0 = time.perf_counter()
+                    resp = stub.Echo(req)
+                    lats.append(time.perf_counter() - t0)
+                    assert len(resp.payload) == len(payload)
+            except BaseException as e:
+                failures.append(e)
+            finally:
+                worker_cpu[threading.get_ident()] = time.thread_time()
+
+        ts = [threading.Thread(target=worker, args=(i,),
+                               name=f"bench-profile-{i}")
+              for i in range(threads)]
+        cpu_base = {t.native_id: _task_cpu_s(t.native_id)
+                    for t in threading.enumerate() if t.native_id}
+        sess = ProfileSession(hz=hz, budget=False,
+                              track_threads=True).start()
+        proc0 = time.process_time()
+        for t in ts:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in ts:
+            t.join()
+        wall = time.perf_counter() - t0
+        proc_cpu_s = time.process_time() - proc0
+        prof = sess.stop()
+        if failures:
+            raise RuntimeError(f"{len(failures)}/{threads} profile workers "
+                               f"failed; first: {failures[0]!r}")
+        lats = sorted(x for l in lat_per_thread for x in l)
+    finally:
+        server.stop()
+        server.join(timeout=2)
+
+    n = threads * calls
+    measured_us = proc_cpu_s / n * 1e6
+    # per-thread OS CPU, distributed over phases by that thread's own
+    # cpu-classified sample mix (all samples when a thread never showed a
+    # cpu-classified leaf)
+    phase_cpu_s = {}
+    covered_cpu_s = 0.0
+    for tid, phases in prof.thread_counts.items():
+        if tid in worker_cpu:
+            cpu = worker_cpu[tid]
+        else:
+            ntid = prof.thread_native.get(tid, 0)
+            cpu = _task_cpu_s(ntid) - cpu_base.get(ntid, 0.0) \
+                if ntid else 0.0
+        if cpu <= 0:
+            continue
+        covered_cpu_s += cpu
+        weights = {ph: c for ph, (w, c) in phases.items() if c}
+        if not weights:
+            weights = {ph: w for ph, (w, c) in phases.items()}
+        wsum = sum(weights.values())
+        for ph, wgt in weights.items():
+            phase_cpu_s[ph] = phase_cpu_s.get(ph, 0.0) + cpu * wgt / wsum
+
+    print(f"# profile lane (in-process tpu:// echo, 4KB, whole-process "
+          f"sampler @{hz:.0f}hz): calls={n} wall={wall:.2f}s "
+          f"qps={n / wall:,.0f} p50={_percentile(lats, 0.5) * 1e6:.0f}us",
+          file=sys.stderr)
+    print("# per-call CPU budget by phase (per-thread OS CPU distributed "
+          "by sample mix):", file=sys.stderr)
+    attributed_us = 0.0
+    for phase, cpu_s in sorted(phase_cpu_s.items(), key=lambda kv: -kv[1]):
+        us = cpu_s / n * 1e6
+        attributed_us += us
+        label = phase if phase != "-" else "- (unmarked: client+framework)"
+        print(f"#   {label:<34} {us:8.1f} us/call", file=sys.stderr)
+    ratio = attributed_us / max(measured_us, 1e-9)
+    print(f"# profile budget: attributed={attributed_us:.1f} us/call  "
+          f"measured(process_time)={measured_us:.1f} us/call  "
+          f"ratio={ratio:.2f}", file=sys.stderr)
+    print(f"# profile sampler overhead: "
+          f"{100.0 * prof.sample_time_s / max(wall, 1e-9):.3f}% of wall "
+          f"({prof.ticks} ticks, {prof.overruns} overruns)",
+          file=sys.stderr)
+    lines = prof.folded_lines()
+    with open(out_path, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(lines) + "\n")
+    print(f"# profile artifact: {out_path} ({len(lines)} stacks, "
+          f"{prof.samples} samples)", file=sys.stderr)
+    print(json.dumps({
+        "metric": "profile_attributed_cpu_ratio",
+        "value": round(ratio, 3),
+        "unit": "attributed/measured",
+        "artifact": out_path,
+    }))
+
+
 def main() -> None:
+    if "--profile" in sys.argv[1:]:
+        bench_profile()
+        return
     if _phase_enabled("qps"):
         bench_multi_threaded_echo()
     native_1mb = tpu_1mb = None

@@ -507,7 +507,53 @@ def rule_metric_flag_hygiene(pkg: Package) -> List[Finding]:
 
 
 # --------------------------------------------------------------------------
-# Rule 7: bounded-spin
+# Rule 7: named-thread
+# --------------------------------------------------------------------------
+# The profiler attributes samples and /status counts vitals by thread; an
+# anonymous "Thread-12" in a flamegraph or a stack dump is unactionable.
+# Every threading.Thread() the framework creates must carry a name= (role
+# registration is runtime — the name is the static half of the contract).
+
+def _is_thread_ctor(call: ast.Call, bare_thread_imported: bool) -> bool:
+    name = attr_chain(call.func)
+    if name is None:
+        return False
+    if name in ("threading.Thread", "_threading.Thread"):
+        return True
+    return name == "Thread" and bare_thread_imported
+
+
+@register_rule(
+    "named-thread",
+    "every threading.Thread(...) construction must pass name= — anonymous "
+    "threads are unattributable in profiles and stack dumps")
+def rule_named_thread(pkg: Package) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in pkg.files:
+        bare = False
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "threading":
+                if any(a.name == "Thread" for a in node.names):
+                    bare = True
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not _is_thread_ctor(node, bare):
+                continue
+            if any(kw.arg is None for kw in node.keywords):
+                continue  # **kwargs — can't prove name is absent
+            if any(kw.arg == "name" for kw in node.keywords):
+                continue
+            out.append(Finding(
+                "named-thread", sf.rel, node.lineno,
+                "threading.Thread(...) without name= — anonymous threads "
+                "show up as Thread-N in /threads and profiler output; "
+                "name it after its role"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# Rule 8: bounded-spin
 # --------------------------------------------------------------------------
 # The wakeup discipline (PR 9): a busy-wait loop — one whose body never
 # parks (no sleep/wait/select/poll/acquire/join/recv/accept/get call) —

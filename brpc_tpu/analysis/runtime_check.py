@@ -126,7 +126,18 @@ class TrackedLock:
         self._lock = lock
 
     def acquire(self, *args, **kwargs) -> bool:
-        got = self._lock.acquire(*args, **kwargs)
+        # uncontended path stays a single extra branch; a blocked acquire
+        # feeds the contention profiler (waits + sampled waiter stacks on
+        # /hotspots/contention, site "lock:<name>")
+        got = self._lock.acquire(False) if not args and not kwargs else False
+        if not got:
+            t0 = time.monotonic_ns()
+            got = self._lock.acquire(*args, **kwargs)
+            if got:
+                from brpc_tpu.fiber.butex import record_contention
+
+                record_contention(f"lock:{self._name}",
+                                  time.monotonic_ns() - t0)
         if got:
             lock_order.on_acquire(self._name)
         return got

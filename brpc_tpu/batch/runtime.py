@@ -25,6 +25,7 @@ from typing import Dict, List, Sequence
 from brpc_tpu.batch import metrics as bmetrics
 from brpc_tpu.batch.policy import BatchPolicy
 from brpc_tpu.batch.queue import BatchItem, BatchQueue
+from brpc_tpu.profiling import registry as _prof
 from brpc_tpu.rpc import errors
 
 log = logging.getLogger("brpc_tpu.batch")
@@ -149,9 +150,11 @@ def run_batch(queue: BatchQueue, items: List[BatchItem], reason: str) -> None:
                            queue=queue.name)
                 spans.append(span)
     t_exec = time.monotonic_ns()
+    prev_ph = _prof.set_phase("execute")
     try:
         responses = queue.vector_fn(ctx)
     except Exception as e:
+        _prof.set_phase(prev_ph)
         if len(items) == 1:
             _finish(queue, items[0], None, errors.EINTERNAL,
                     f"batched handler raised: {e!r}")
@@ -165,6 +168,7 @@ def run_batch(queue: BatchQueue, items: List[BatchItem], reason: str) -> None:
         for it in items:
             run_batch(queue, [it], "isolate")
         return
+    _prof.set_phase(prev_ph)
     # the vectorized call's wall time is every rider's device time: each
     # item waited for the whole call, so each span carries the full mark
     exec_us = (time.monotonic_ns() - t_exec) / 1000.0

@@ -51,9 +51,35 @@ def index_service(server, http: HttpMessage):
 
 
 # --------------------------------------------------------------------- status
+def _rss_kb() -> int:
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * (os.sysconf("SC_PAGE_SIZE") // 1024)
+    except (OSError, ValueError, IndexError):
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+
 def status_service(server, http: HttpMessage):
+    import tracemalloc
+
+    from brpc_tpu.profiling import registry as _prof_reg
+    from brpc_tpu.profiling import continuous as _prof_cont
+
+    by_role = _prof_reg.threads_by_role()
+    roles = " ".join(f"{r}={n}" for r, n in sorted(by_role.items()))
+    cont = _prof_cont()
     out = [f"version: {brpc_tpu.__version__}",
-           f"uptime_s: {time.time() - _start_time:.0f}"]
+           f"uptime_s: {time.time() - _start_time:.0f}",
+           f"rss_kb: {_rss_kb()}",
+           f"threads: {sum(by_role.values())} ({roles})",
+           f"tracemalloc: {'on' if tracemalloc.is_tracing() else 'off'}",
+           f"continuous_profiler: "
+           f"{'running' if cont is not None and cont.is_alive() else 'off'}",
+           "profilers: /hotspots/cpu /hotspots/continuous "
+           "/hotspots/contention /hotspots/heap /pprof/profile /flame"]
     if server is not None:
         ep = server.listen_endpoint()
         out += [f"listen: {ep}",

@@ -37,6 +37,7 @@ def record_contention(site: str, wait_ns: int) -> None:
         else:
             ent[0] += 1
             ent[1] += wait_ns
+    _maybe_capture_stack(site, wait_ns)
 
 
 def contention_stats() -> List[Tuple[str, int, int]]:
@@ -44,6 +45,70 @@ def contention_stats() -> List[Tuple[str, int, int]]:
     with _contention_lock:
         rows = [(site, ent[0], ent[1]) for site, ent in _contention.items()]
     return sorted(rows, key=lambda r: -r[2])
+
+
+# sampled waiter STACKS per site (reference contention profiler records
+# where waiters came from, not just the wait word's label): site ->
+# collapsed stack -> [waits, total_wait_ns]. Collector-budget-gated so the
+# capture cost scales with the observability budget, not the wait rate.
+_contention_stacks: Dict[str, Dict[Tuple[str, ...], List[int]]] = {}
+_MAX_STACK_SITES = 256
+_MAX_STACKS_PER_SITE = 8
+_collector = None
+
+
+def _maybe_capture_stack(site: str, wait_ns: int) -> None:
+    global _collector
+    if _collector is None:
+        from brpc_tpu.metrics.collector import global_collector
+
+        _collector = global_collector()
+    if (time.monotonic() < _collector._deny_until
+            or not _collector.ask_to_be_sampled()):
+        return
+    import sys
+
+    from brpc_tpu.profiling.sampler import collapse
+
+    # _getframe(2): the caller of record_contention — the wait site itself
+    # (Butex.wait, TrackedLock.acquire, ...)
+    try:
+        frame = sys._getframe(2)
+    except ValueError:
+        frame = sys._getframe()
+    stack = collapse(frame)
+    with _contention_lock:
+        stacks = _contention_stacks.get(site)
+        if stacks is None:
+            if len(_contention_stacks) >= _MAX_STACK_SITES:
+                return
+            stacks = _contention_stacks[site] = {}
+        ent = stacks.get(stack)
+        if ent is None:
+            if len(stacks) >= _MAX_STACKS_PER_SITE:
+                return
+            stacks[stack] = [1, wait_ns]
+        else:
+            ent[0] += 1
+            ent[1] += wait_ns
+
+
+def contention_stacks() -> Dict[str, List[Tuple[str, int, int]]]:
+    """site -> [(folded_stack, waits, total_wait_ns)] sorted by wait time
+    desc within each site."""
+    with _contention_lock:
+        out = {}
+        for site, stacks in _contention_stacks.items():
+            rows = [(";".join(st), ent[0], ent[1])
+                    for st, ent in stacks.items()]
+            out[site] = sorted(rows, key=lambda r: -r[2])
+    return out
+
+
+def reset_contention_for_test() -> None:
+    with _contention_lock:
+        _contention.clear()
+        _contention_stacks.clear()
 
 
 class Butex:

@@ -114,6 +114,9 @@ class _Worker(threading.Thread):
         self.current: Optional[FiberTask] = None  # /fibers task visibility
 
     def run(self) -> None:
+        from brpc_tpu.profiling import registry as _prof
+
+        _prof.register_current_thread(_prof.ROLE_WORKER)
         control = self.control
         lot = control._lot(self.tag)
         while not control._stopped:

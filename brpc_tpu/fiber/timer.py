@@ -80,6 +80,9 @@ class TimerThread:
 
     # ------------------------------------------------------------------ loop
     def _run(self) -> None:
+        from brpc_tpu.profiling import registry as _prof
+
+        _prof.register_current_thread(_prof.ROLE_TIMER)
         while True:
             with self._cond:
                 while not self._stopped:

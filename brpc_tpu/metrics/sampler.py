@@ -69,6 +69,9 @@ class SamplerCollector:
             self._thread.start()
 
     def _run(self) -> None:
+        from brpc_tpu.profiling import registry as _prof
+
+        _prof.register_current_thread(_prof.ROLE_SAMPLER)
         while not self._stop.wait(self._interval):
             try:
                 self.tick_all()

@@ -452,7 +452,8 @@ class RtmpClient:
         self.on_frame: Optional[Callable[[int, int, bytes], None]] = None
         self._closed = False
         self._handshake()
-        self._thread = threading.Thread(target=self._read_loop, daemon=True)
+        self._thread = threading.Thread(target=self._read_loop,
+                                        name="rtmp-read", daemon=True)
         self._thread.start()
         # announce our chunk size BEFORE any message that exceeds the
         # 128-byte protocol default (RTMP spec §5.4.1)
@@ -497,6 +498,9 @@ class RtmpClient:
             return self._results.pop(txn)
 
     def _read_loop(self) -> None:
+        from brpc_tpu.profiling import registry as _prof
+
+        _prof.register_current_thread(_prof.ROLE_POLLER)
         try:
             while not self._closed:
                 try:

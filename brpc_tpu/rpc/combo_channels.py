@@ -245,7 +245,8 @@ class ParallelChannel:
             except Exception as e:  # noqa: BLE001 — joined below
                 fails.append(e)
 
-        threads = [threading.Thread(target=one, args=(i, ch, sh))
+        threads = [threading.Thread(target=one, args=(i, ch, sh),
+                                    name=f"combo-shard-{i}")
                    for i, ((ch, _m, _g), sh) in enumerate(zip(self._subs,
                                                               shards))]
         for t in threads:

@@ -169,6 +169,9 @@ class EventDispatcher:
         # parked exactly as before.
         # small ceiling: each probe is a syscall, and a dry decay from the
         # cap must stay well under the 1ms scale the spin is trying to win
+        from brpc_tpu.profiling import registry as _prof
+
+        _prof.register_current_thread(_prof.ROLE_POLLER)
         spin = _wakeup.get_spin(f"dispatcher:{self._thread.name}",
                                 initial=8, floor=1, ceiling=64)
         spin_left = 0

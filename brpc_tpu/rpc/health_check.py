@@ -92,6 +92,9 @@ class HealthChecker:
         self._thread.start()
 
     def _run(self) -> None:
+        from brpc_tpu.profiling import registry as _prof
+
+        _prof.register_current_thread(_prof.ROLE_HEALER)
         while not self._stop.wait(self._interval):
             try:
                 self._check_once()

@@ -28,6 +28,7 @@ from brpc_tpu.rpc.protocol import (
     find_protocol,
     list_protocols,
 )
+from brpc_tpu.profiling import registry as _prof
 from brpc_tpu.rpc import errors
 from brpc_tpu.rpc import run_to_completion as _rtc
 from brpc_tpu.rpc.socket import Socket
@@ -125,6 +126,10 @@ class InputMessenger:
         e.g. stream frames, re-serialize in their own ExecutionQueue)."""
         count = 0
         server = self._server
+        # profiler phase marker: cutting/framing cost on this thread is
+        # "parse"; inline (run-to-completion) dispatch re-stamps its own
+        # phases and restores back here
+        prev_ph = _prof.set_phase("parse")
         # transports that defer flow-control credits (the tpu tunnel's
         # borrowed registered blocks) bracket the cut loop so every credit
         # released while this batch parses coalesces into one ACK frame
@@ -197,6 +202,7 @@ class InputMessenger:
                         runtime.start_background(
                             _rtc.observe_queued, msg, server)
         finally:
+            _prof.set_phase(prev_ph)
             if batch_hook is not None:
                 batch_hook.cut_batch_end()
             hook = poll_batch_hook

@@ -690,6 +690,9 @@ class NativeDataplane:
         ctypes field reads, string_at pairs, and dp_free crossings are
         gone for small events. Big events arrive as pointer records and
         keep the zero-copy donation semantics."""
+        from brpc_tpu.profiling import registry as _prof
+
+        _prof.register_current_thread(_prof.ROLE_POLLER)
         _flusher_tls.on = True
         global _fp_fn
         if _fp_fn is None:

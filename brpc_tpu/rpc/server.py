@@ -263,6 +263,11 @@ class Server:
 
         install_crash_handler()  # SIGSEGV/ABRT dump all stacks (butil/debug)
         ensure_registered()
+        # always-on low-rate profiler: serving processes keep an N-minute
+        # ring of folded-stack windows (/hotspots/continuous)
+        from brpc_tpu.profiling import ensure_continuous_started
+
+        ensure_continuous_started()
         if "Health" not in self._services:
             # builtin grpc.health.v1.Health (reference server.cpp:499-601
             # AddBuiltinServices / grpc_health_check_service)

@@ -19,8 +19,13 @@ from brpc_tpu.metrics.reducer import Adder
 from brpc_tpu.policy import compress as _compress
 from brpc_tpu.proto import rpc_meta_pb2
 from brpc_tpu.rpc import errors
+from brpc_tpu.profiling import registry as _prof
 from brpc_tpu.rpc.controller import Controller
 from brpc_tpu.trace import span as _tspan
+
+# per-thread phase marker for the statistical profiler: the sampler reads
+# it from outside this thread to attribute CPU samples to span phases
+_set_phase = _prof.set_phase
 
 # requests rejected because their client timeout budget was already spent
 # before the handler could run (server-side deadline enforcement)
@@ -202,6 +207,7 @@ def process_rpc_request(protocol, msg, server) -> None:
         if responded[0]:
             return
         responded[0] = True
+        prev_ph = _set_phase("respond")
         t_resp = time.perf_counter_ns()
         payload_out = b""
         if response is not None and not cntl.failed():
@@ -236,9 +242,11 @@ def process_rpc_request(protocol, msg, server) -> None:
             ph = cntl.span.phases
             el -= ph.get("send_us", 0.0) + ph.get("credit_wait_us", 0.0)
             cntl.span.add_phase("respond_us", max(0.0, el))
+        _set_phase(prev_ph)
         _settle(cntl.error_code)
 
     try:
+        _set_phase("parse")
         t_split = time.perf_counter_ns() if cntl.span is not None else 0
         payload, attachment = protocol.split_attachment(msg)
         if cntl.span is not None:
@@ -271,6 +279,7 @@ def process_rpc_request(protocol, msg, server) -> None:
         # USER CODE (reference svc->CallMethod, :838-854); the server span
         # is "current" while it runs so downstream calls stitch the trace
         prev_span = _span.set_current(cntl.span)
+        _set_phase("execute")
         t_exec = time.perf_counter_ns()
         ex0 = _other_marks(cntl.span)
         try:
@@ -298,6 +307,8 @@ def process_rpc_request(protocol, msg, server) -> None:
     except BaseException:
         _settle(errors.EINTERNAL)
         raise
+    finally:
+        _set_phase(None)
 
 
 # ===================================================================== slim
@@ -342,6 +353,7 @@ class _SlimDone:
         if self.responded:
             return
         self.responded = True
+        prev_ph = _set_phase("respond")
         cntl = self.cntl
         span = cntl.span
         t_resp = time.perf_counter_ns() if span is not None else 0
@@ -377,6 +389,7 @@ class _SlimDone:
             span.add_phase("respond_us", max(0.0, el))
         else:
             self.sock.write(packet)
+        _set_phase(prev_ph)
         self.settle(code)
 
     def settle(self, error_code: int) -> None:
@@ -468,6 +481,7 @@ def _process_request_slim(protocol, msg, server, meta) -> bool:
     done = _SlimDone(protocol, sock, meta, cntl, entry, server, start_us)
 
     try:
+        _set_phase("parse")
         t_parse = time.perf_counter_ns() if span is not None else 0
         body = msg.body
         if span is not None:
@@ -485,6 +499,7 @@ def _process_request_slim(protocol, msg, server, meta) -> bool:
             span.add_phase(
                 "parse_us", (time.perf_counter_ns() - t_parse) / 1000.0)
         prev_span = _tspan.set_current(span)
+        _set_phase("execute")
         t_exec = time.perf_counter_ns() if span is not None else 0
         ex0 = _other_marks(span)
         try:
@@ -509,6 +524,8 @@ def _process_request_slim(protocol, msg, server, meta) -> bool:
     except BaseException:
         done.settle(errors.EINTERNAL)
         raise
+    finally:
+        _set_phase(None)
     return True
 
 
@@ -712,6 +729,7 @@ def fast_process_request(item) -> None:
     done.pending_dump = pending_dump
 
     try:
+        _set_phase("parse")
         t_parse = time.perf_counter_ns() if span is not None else 0
         try:
             request = entry.request_class()
@@ -724,6 +742,7 @@ def fast_process_request(item) -> None:
             span.add_phase(
                 "parse_us", (time.perf_counter_ns() - t_parse) / 1000.0)
         prev_span = _span.set_current(span)
+        _set_phase("execute")
         t_exec = time.perf_counter_ns() if span is not None else 0
         ex0 = _other_marks(span)
         try:
@@ -747,6 +766,8 @@ def fast_process_request(item) -> None:
     except BaseException:
         done.settle(errors.EINTERNAL)
         raise
+    finally:
+        _set_phase(None)
 
 
 class _FastDone:
@@ -775,6 +796,7 @@ class _FastDone:
         if self.responded:
             return
         self.responded = True
+        prev_ph = _set_phase("respond")
         cntl = self.cntl
         span = cntl.span
         t_resp = time.perf_counter_ns() if span is not None else 0
@@ -794,6 +816,7 @@ class _FastDone:
                                   + len(cntl.response_attachment or b""))
             span.add_phase(
                 "respond_us", (time.perf_counter_ns() - t_resp) / 1000.0)
+        _set_phase(prev_ph)
         self.settle(code)
 
     def settle(self, error_code: int) -> None:

@@ -217,6 +217,9 @@ class DeviceStore:
     def _dispatch_loop(self) -> None:
         import logging
 
+        from brpc_tpu.profiling import registry as _prof
+
+        _prof.register_current_thread(_prof.ROLE_BATCH)
         while True:
             with self._dq_cv:
                 while not self._dq:

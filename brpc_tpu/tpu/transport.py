@@ -60,6 +60,7 @@ from brpc_tpu.butil.resource_pool import VersionedPool
 from brpc_tpu.fiber import call_id as _cid
 from brpc_tpu.fiber import wakeup as _wakeup
 from brpc_tpu.metrics.reducer import Adder
+from brpc_tpu.profiling import registry as _prof
 from brpc_tpu.rpc import errors
 from brpc_tpu.rpc.protocol import (
     PARSE_BAD,
@@ -418,14 +419,22 @@ class PeerWindow:
             # adaptive spin before the locked park: under streaming-parse
             # credit return the refill usually lands within the spin
             # budget, and winning here skips the full park/notify round
-            _window_spin.spin(lambda: bool(self._free) or self._closed)
+            prev_ph = _prof.set_phase("credit_wait")
+            try:
+                _window_spin.spin(lambda: bool(self._free) or self._closed)
+            finally:
+                _prof.set_phase(prev_ph)
         deadline = _time.monotonic() + timeout
         with self._cond:
             while not self._free and not self._closed:
                 left = deadline - _time.monotonic()
                 if left <= 0:
                     return None
-                self._cond.wait(left)
+                prev_ph = _prof.set_phase("credit_wait")
+                try:
+                    self._cond.wait(left)
+                finally:
+                    _prof.set_phase(prev_ph)
             if self._closed:
                 return None
             take = min(want, len(self._free))
@@ -855,6 +864,9 @@ class TpuEndpoint:
             on_main_lane = self._send_lock.acquire(blocking=False)
         else:
             self._send_lock.acquire()
+        # profiler phase marker: samples landing in the copy/frame loops
+        # attribute to "send"; credit stalls re-stamp "credit_wait" inside
+        prev_ph = _prof.set_phase("send")
         if on_main_lane:
             try:
                 if self._failed:
@@ -873,11 +885,15 @@ class TpuEndpoint:
                     raise
             finally:
                 self._send_lock.release()
+                _prof.set_phase(prev_ph)
         else:
             # main lane mid-bulk-send: divert to the priority sub-stream
             # (frame-granular interleave on the ctrl socket is safe — the
             # receiver demuxes FT_DATA_PRI into a separate virtual socket)
-            rc, partial = self._send_pri(views, total), False
+            try:
+                rc, partial = self._send_pri(views, total), False
+            finally:
+                _prof.set_phase(prev_ph)
         if rc == 0:
             self.vsock.out_bytes += total
         if span is not None:
@@ -1679,6 +1695,7 @@ class TunnelHealer:
     def _bg_heal(self, ep: EndPoint) -> None:
         from brpc_tpu import flags as _flags
 
+        _prof.register_current_thread(_prof.ROLE_HEALER)
         try:
             self.connect(ep, _flags.get("tpu_reconnect_window_s"))
         except Exception:

@@ -167,6 +167,105 @@ def test_tunnel_counters_on_vars(bench_run):
         assert get_exposed(name) is not None, name
 
 
+@pytest.fixture(scope="module")
+def profile_bench_run(tmp_path_factory):
+    out = tmp_path_factory.mktemp("prof") / "bench.folded"
+    env = dict(os.environ,
+               BENCH_QUICK="1",
+               BENCH_PROFILE_OUT=str(out),
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
+                           "--profile"],
+                          capture_output=True, text=True, timeout=240,
+                          cwd=REPO, env=env)
+    assert proc.returncode == 0, \
+        f"bench.py --profile failed rc={proc.returncode}:\n" \
+        f"{proc.stderr[-2000:]}"
+    return proc, out
+
+
+def test_profile_folded_artifact(profile_bench_run):
+    """--profile must leave a non-empty folded-stacks artifact the flame
+    and diff tools can consume."""
+    proc, out = profile_bench_run
+    text = out.read_text()
+    stacks = [l for l in text.splitlines()
+              if l and not l.startswith("#")]
+    assert stacks, text[:500]
+    for line in stacks:
+        stack, _, weight = line.rpartition(" ")
+        assert int(weight) > 0, line
+        assert stack.startswith("role="), line
+        assert ";phase=" in stack, line
+
+
+def test_profile_budget_table_and_ratio(profile_bench_run):
+    """The per-call CPU budget table must print per-phase us/call rows and
+    an attributed-vs-measured sum within the +-25% acceptance band."""
+    proc, _ = profile_bench_run
+    err = proc.stderr
+    assert "# per-call CPU budget by phase" in err
+    phase_rows = [l for l in err.splitlines()
+                  if l.startswith("#   ") and "us/call" in l]
+    assert len(phase_rows) >= 2, err[-2000:]
+    budget = [l for l in err.splitlines()
+              if l.startswith("# profile budget:")]
+    assert budget, err[-2000:]
+    ratio = float(budget[0].split("ratio=")[1])
+    assert 0.75 <= ratio <= 1.25, budget[0]
+    # and the machine-readable line on stdout agrees
+    rows = [json.loads(l) for l in proc.stdout.splitlines()
+            if l.startswith("{")]
+    metric = [r for r in rows
+              if r["metric"] == "profile_attributed_cpu_ratio"]
+    assert len(metric) == 1, proc.stdout
+    assert 0.75 <= metric[0]["value"] <= 1.25, metric[0]
+
+
+def test_sampler_overhead_under_two_pct_at_default_hz():
+    """The always-on rate must be affordable: sampling a live 64B echo
+    lane at the default continuous hz costs <2% of wall time."""
+    import time
+
+    from brpc_tpu import flags as _flags
+    from brpc_tpu.profiling.sampler import ProfileSession
+    from brpc_tpu.proto import echo_pb2
+    from brpc_tpu.rpc import Channel, ChannelOptions, Server, Service, Stub
+
+    ECHO = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+    class EchoImpl(Service):
+        DESCRIPTOR = ECHO
+
+        def Echo(self, cntl, request, done):
+            return echo_pb2.EchoResponse(message=request.message,
+                                         payload=request.payload)
+
+    hz = float(_flags.get("tpu_prof_continuous_hz"))
+    assert hz > 0
+    srv = Server().add_service(EchoImpl()).start("tpu://127.0.0.1:0/0")
+    try:
+        ch = Channel(ChannelOptions(protocol="trpc_std", timeout_ms=10000))
+        ch.init(str(srv.listen_endpoint()))
+        stub = Stub(ch, ECHO)
+        req = echo_pb2.EchoRequest(message="x", payload=b"\xab" * 64)
+        stub.Echo(req)  # warmup
+        sess = ProfileSession(hz=hz, budget=False).start()
+        t0 = time.monotonic()
+        deadline = t0 + 1.5
+        while time.monotonic() < deadline:
+            stub.Echo(req)
+        wall = time.monotonic() - t0
+        prof = sess.stop()
+    finally:
+        srv.stop()
+        srv.join(timeout=2)
+    overhead = prof.sample_time_s / wall
+    assert overhead < 0.02, (
+        f"sampler self-time {overhead:.2%} of wall at {hz:g}hz "
+        f"({prof.ticks} ticks, sample_time={prof.sample_time_s:.4f}s)")
+
+
 def test_record_replay_diff_smoke(tmp_path):
     """The record -> replay -> diff loop on the shm lane, end to end
     through the CLI tools: ~2s of recorded echo traffic over tpu://, a 2x

@@ -16,6 +16,7 @@ REPO = Path(__file__).resolve().parent.parent
 EXPECTED_RULES = {
     "no-blocking-in-poller", "acquire-release", "monotonic-clock",
     "lock-order", "version-guard", "metric-flag-hygiene", "bounded-spin",
+    "named-thread",
 }
 
 
@@ -438,6 +439,65 @@ class TestBoundedSpin:
                         break
             """}, rules=["bounded-spin"])
         assert res.clean
+
+
+# ------------------------------------------------------------ named-thread
+class TestNamedThread:
+    def test_anonymous_thread_fires(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/foo.py": """\
+            import threading
+            def spawn(self):
+                t = threading.Thread(target=self._run, daemon=True)
+                t.start()
+            """}, rules=["named-thread"])
+        assert len(res.findings) == 1
+        assert res.findings[0].line == 3
+        assert "name=" in res.findings[0].message
+
+    def test_bare_import_fires(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/foo.py": """\
+            from threading import Thread
+            def spawn(self):
+                Thread(target=self._run).start()
+            """}, rules=["named-thread"])
+        assert len(res.findings) == 1
+
+    def test_named_thread_passes(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/foo.py": """\
+            import threading
+            def spawn(self):
+                threading.Thread(target=self._run, name="rpc-healer",
+                                 daemon=True).start()
+            """}, rules=["named-thread"])
+        assert res.clean
+
+    def test_kwargs_splat_passes(self, tmp_path):
+        # **kw may carry name= — can't prove absence statically
+        res = _lint(tmp_path, {"rpc/foo.py": """\
+            import threading
+            def spawn(self, **kw):
+                threading.Thread(target=self._run, **kw).start()
+            """}, rules=["named-thread"])
+        assert res.clean
+
+    def test_unrelated_thread_class_passes(self, tmp_path):
+        # a local class merely NAMED Thread is not threading.Thread
+        res = _lint(tmp_path, {"rpc/foo.py": """\
+            class Thread:
+                pass
+            def f():
+                return Thread()
+            """}, rules=["named-thread"])
+        assert res.clean
+
+    def test_suppression_comment_silences(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/foo.py": """\
+            import threading
+            def spawn(self):
+                # tpulint: disable=named-thread
+                threading.Thread(target=self._run).start()
+            """}, rules=["named-thread"])
+        assert res.clean and len(res.suppressed) == 1
 
 
 # ------------------------------------------------------------- suppression
