@@ -994,9 +994,64 @@ def bench_profile():
     }))
 
 
+def bench_shard_sweep(spec: str) -> None:
+    """``bench.py --workers 0,1,2``: the 64B tpu:// echo QPS per shard
+    worker count. Emits one ``echo_64b_qps_w<N>`` JSON line per N plus
+    ``shard_scaling_efficiency`` = QPS(maxN) / (maxN x QPS(1)) when the
+    sweep includes both 1 and a larger N (BENCH_r06). On a 1-core box the
+    efficiency is expected << 1 (the workers time-slice one core); the
+    metric is bench-gated, not asserted."""
+    from brpc_tpu.proto import echo_pb2
+    from brpc_tpu.rpc import Channel, ChannelOptions, Stub
+
+    ns = [int(x) for x in spec.split(",") if x.strip() != ""]
+    qps_by_n = {}
+    for n in ns:
+        extra = ("--shard-workers", str(n)) if n > 0 else ()
+        srv = _BenchServer("tpu://127.0.0.1:0/0", *extra)
+        try:
+            ch = Channel(ChannelOptions(protocol="trpc_std",
+                                        timeout_ms=60000))
+            ch.init(srv.endpoint)
+            stub = Stub(ch,
+                        echo_pb2.DESCRIPTOR.services_by_name["EchoService"])
+            _run_calls(stub, echo_pb2, b"w" * 64, 2, 20)  # warmup
+            wall, lats = _run_calls(stub, echo_pb2, b"\xab" * 64,
+                                    QPS_THREADS, 60 if QUICK else 600)
+            qps = len(lats) / wall
+            qps_by_n[n] = qps
+            print(f"# shard sweep workers={n}: qps={qps:9,.0f} "
+                  f"p50={_percentile(lats, 0.5)*1e3:.2f}ms "
+                  f"p99={_percentile(lats, 0.99)*1e3:.2f}ms",
+                  file=sys.stderr)
+        finally:
+            srv.close()
+    for n, qps in qps_by_n.items():
+        print(json.dumps({
+            "metric": f"echo_64b_qps_w{n}",
+            "value": round(qps, 1),
+            "unit": "qps",
+            "vs_baseline": round(qps / BASELINE_64B_QPS, 3),
+        }))
+    top = max((n for n in qps_by_n if n > 0), default=0)
+    if top > 1 and 1 in qps_by_n and qps_by_n[1] > 0:
+        eff = qps_by_n[top] / (top * qps_by_n[1])
+        print(json.dumps({
+            "metric": "shard_scaling_efficiency",
+            "value": round(eff, 3),
+            "unit": "ratio",
+            "workers": top,
+        }))
+
+
 def main() -> None:
     if "--profile" in sys.argv[1:]:
         bench_profile()
+        return
+    if "--workers" in sys.argv[1:]:
+        i = sys.argv.index("--workers")
+        spec = sys.argv[i + 1] if i + 1 < len(sys.argv) else "0,1,2"
+        bench_shard_sweep(spec)
         return
     if _phase_enabled("qps"):
         bench_multi_threaded_echo()

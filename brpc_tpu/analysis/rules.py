@@ -672,3 +672,110 @@ def rule_bounded_spin(pkg: Package) -> List[Finding]:
                 "condition — bound it with a fiber.wakeup.AdaptiveSpin "
                 "budget or park between probes"))
     return out
+
+
+# --------------------------------------------------------------------------
+# Rule 9: cross-process-ownership
+# --------------------------------------------------------------------------
+# The shard plane's handle-passing contract (docs/sharded-dispatch.md):
+# what crosses a worker process boundary is named shm handles, block
+# indices, and byte lengths — never live ownership objects. Pickling an
+# IOBuf/Block/pool/socket "works" (the bytes copy across) but silently
+# forks ownership: two processes each believe they hold the buffer or the
+# credit, and release hooks fire twice or never. Scope: brpc_tpu/shard/
+# wholesale — the only package that talks across the boundary.
+
+_XPO_SCOPE_PREFIXES = ("shard/",)
+_XPO_BANNED_IMPORTS = {"pickle", "cPickle", "dill", "marshal"}
+_XPO_BANNED_MP = {"Queue", "SimpleQueue", "JoinableQueue", "Pipe",
+                  "Manager", "Pool"}
+_XPO_OWNED_CTORS = {"IOBuf", "BlockPool", "PeerWindow",
+                    "TpuTransportSocket", "socket"}
+_XPO_OWNED_ATTRS = {"read_buf", "ctrl", "vsock"}
+_XPO_SEND_CALLS = {"push", "send", "send_bytes", "put", "put_nowait",
+                   "dumps"}
+
+
+@register_rule(
+    "cross-process-ownership",
+    "code under brpc_tpu/shard/ may not pickle or queue live ownership "
+    "objects (IOBuf, pools, sockets) across the process boundary — only "
+    "named shm handles, block indices, and byte lengths cross")
+def rule_cross_process_ownership(pkg: Package) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in pkg.files:
+        if not in_scope(sf.rel, prefixes=_XPO_SCOPE_PREFIXES):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.split(".")[0] in _XPO_BANNED_IMPORTS:
+                        out.append(Finding(
+                            "cross-process-ownership", sf.rel, node.lineno,
+                            f"import {a.name} in shard/ — serialized "
+                            f"objects fork ownership across the process "
+                            f"boundary; ship named handles and indices "
+                            f"instead"))
+            elif isinstance(node, ast.ImportFrom):
+                mod = (node.module or "").split(".")[0]
+                if mod in _XPO_BANNED_IMPORTS:
+                    out.append(Finding(
+                        "cross-process-ownership", sf.rel, node.lineno,
+                        f"from {node.module} import ... in shard/ — "
+                        f"serialized objects fork ownership across the "
+                        f"process boundary; ship named handles instead"))
+                elif mod == "multiprocessing":
+                    for a in node.names:
+                        if a.name in _XPO_BANNED_MP:
+                            out.append(Finding(
+                                "cross-process-ownership", sf.rel,
+                                node.lineno,
+                                f"multiprocessing.{a.name} pickles its "
+                                f"payload under the hood — shard rings "
+                                f"carry flat bytes only (shared_memory "
+                                f"and resource_tracker are the allowed "
+                                f"multiprocessing imports)"))
+            elif isinstance(node, ast.Call):
+                name = attr_chain(node.func) or ""
+                last = name.split(".")[-1]
+                if last in _XPO_BANNED_MP and (
+                        name.startswith("multiprocessing.")
+                        or name.startswith("mp.")):
+                    out.append(Finding(
+                        "cross-process-ownership", sf.rel, node.lineno,
+                        f"{name}() pickles its payload under the hood — "
+                        f"shard rings carry flat bytes only"))
+        # per-function taint pass: a name bound from an ownership ctor or
+        # an owned attribute must not be handed to a cross-boundary send
+        for func, _cls in iter_functions(sf.tree):
+            tainted: Set[str] = set()
+            for node in ast.walk(func):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    v = node.value
+                    src = None
+                    if isinstance(v, ast.Call):
+                        src = (attr_chain(v.func) or "").split(".")[-1]
+                    elif isinstance(v, ast.Attribute):
+                        src = v.attr
+                    if src in _XPO_OWNED_CTORS or src in _XPO_OWNED_ATTRS:
+                        tainted.add(node.targets[0].id)
+            if not tainted:
+                continue
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = attr_chain(node.func) or ""
+                if name.split(".")[-1] not in _XPO_SEND_CALLS:
+                    continue
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id in tainted:
+                        out.append(Finding(
+                            "cross-process-ownership", sf.rel, node.lineno,
+                            f"'{arg.id}' holds a live ownership object "
+                            f"(IOBuf/pool/socket) passed to {name}() — "
+                            f"only named handles, block indices, and "
+                            f"byte lengths may cross the process "
+                            f"boundary"))
+    return out

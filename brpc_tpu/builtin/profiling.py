@@ -139,6 +139,33 @@ def cpu_service(server, http: HttpMessage):
         _lock.release()
 
 
+def _merge_worker_stacks(prof, server) -> None:
+    """Fold shard-worker stacks into a continuous-profiler query: each
+    worker process samples itself and ships top folded lines home over
+    its ring (W_PROF), already role-tagged ``worker:<i>/...`` by the
+    registry prefix — so one /hotspots/continuous view covers the whole
+    plane, parent and workers."""
+    plane = getattr(server, "_shard_plane", None) if server is not None \
+        else None
+    if plane is None:
+        return
+    for ln in plane.worker_folded_lines():
+        try:
+            stack, n = ln.rsplit(" ", 1)
+            parts = stack.split(";")
+            role = phase = ""
+            while parts and (parts[0].startswith("role=")
+                             or parts[0].startswith("phase=")):
+                head = parts.pop(0)
+                if head.startswith("role="):
+                    role = head[5:]
+                else:
+                    phase = head[6:]
+            prof.add(role, phase, tuple(parts), int(n))
+        except (ValueError, IndexError):
+            continue
+
+
 # ------------------------------------------------------------ continuous
 def continuous_service(server, http: HttpMessage):
     """/hotspots/continuous — query the always-on low-rate profiler's
@@ -177,6 +204,7 @@ def continuous_service(server, http: HttpMessage):
         return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
 
     prof = cont.query(frm, to)
+    _merge_worker_stacks(prof, server)
     b_frm, b_to = _ts("base_from"), _ts("base_to")
     if b_frm is not None or b_to is not None:
         from brpc_tpu.profiling import diff as _diff

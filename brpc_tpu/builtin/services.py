@@ -386,6 +386,9 @@ def tpu_service(server, http: HttpMessage):
 
     state["wakeup"] = _wakeup.stats()
     state["rtc"] = _rtc.stats()
+    plane = getattr(server, "_shard_plane", None) if server else None
+    if plane is not None:
+        state["shard"] = plane.state_dict()
     if http.query.get("format", "") == "json":
         return 200, CONTENT_JSON, json.dumps(state, indent=2) + "\n"
 
@@ -455,6 +458,25 @@ def tpu_service(server, http: HttpMessage):
             f"  {name}: ema_us={m['ema_us']} samples={m['samples']} "
             f"hits={m['hits']} demoted={m['demoted']} "
             f"opted_in={m['opted_in']}")
+    shard = state.get("shard")
+    if shard is not None:
+        lines.append("")
+        lines.append("== shard plane ==")
+        lines.append(
+            f"workers={shard['workers_configured']} "
+            f"generation={shard['generation']} "
+            f"forwarded={shard['forwarded']} "
+            f"fallback={shard['fallback']} "
+            f"fanin_batches={shard['fanin_batches']} "
+            f"fanin_frames={shard['fanin_frames']}")
+        for wd in shard["workers"]:
+            lines.append(
+                f"  {wd['role']}: pid={wd['pid']} alive={wd['alive']} "
+                f"gen={wd['gen']} respawns={wd['respawns']} "
+                f"inflight_cids={wd['inflight_cids']} "
+                f"lease_held={wd['lease_held']} "
+                f"lease_free={wd['lease_free']} "
+                f"dispatched={wd['dispatched']}")
     return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
 
 

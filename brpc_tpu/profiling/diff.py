@@ -67,10 +67,13 @@ def total_weights(counts: Counts) -> Dict[str, int]:
 
 
 def diff_folded(base, new, top: int = 20,
-                min_delta_pct: float = 0.5, mode: str = "self") -> dict:
+                min_delta_pct: float = 0.5, mode: str = "self",
+                only_prefix: str = "") -> dict:
     """Rank frames by |share(new) - share(base)|, dropping movers below
     min_delta_pct percentage points. mode: 'self' (leaf time, default) or
-    'total' (frame anywhere on stack)."""
+    'total' (frame anywhere on stack). only_prefix restricts ranking to
+    frames starting with it — "phase=" with mode='total' turns the diff
+    into a per-phase CPU-share ratchet over the synthetic root frames."""
     base_counts, new_counts = _as_counts(base), _as_counts(new)
     weigh = self_weights if mode == "self" else total_weights
     bw, nw = weigh(base_counts), weigh(new_counts)
@@ -78,6 +81,8 @@ def diff_folded(base, new, top: int = 20,
     new_total = max(sum(new_counts.values()), 1)
     movers: List[dict] = []
     for frame in set(bw) | set(nw):
+        if only_prefix and not frame.startswith(only_prefix):
+            continue
         b, n = bw.get(frame, 0), nw.get(frame, 0)
         b_pct = 100.0 * b / base_total
         n_pct = 100.0 * n / new_total
@@ -90,7 +95,8 @@ def diff_folded(base, new, top: int = 20,
                        "delta_pct": round(delta, 2)})
     movers.sort(key=lambda m: -abs(m["delta_pct"]))
     return {"mode": mode, "base_total": base_total, "new_total": new_total,
-            "min_delta_pct": min_delta_pct, "movers": movers[:top],
+            "min_delta_pct": min_delta_pct, "only_prefix": only_prefix,
+            "movers": movers[:top],
             "suppressed": max(len(movers) - top, 0)}
 
 

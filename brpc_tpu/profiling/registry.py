@@ -41,11 +41,21 @@ ROLE_USER = "user"          # anything unregistered (main thread, app threads)
 _roles: Dict[int, str] = {}
 _phases: Dict[int, str] = {}
 
+# process-wide role prefix: shard worker processes set "worker:<i>/" once
+# at startup so every role they register — and the unregistered default —
+# carries the worker identity when folded stacks are merged parent-side
+_role_prefix = ""
+
+
+def set_role_prefix(prefix: str) -> None:
+    global _role_prefix
+    _role_prefix = prefix
+
 
 # ------------------------------------------------------------------- roles
 def register_current_thread(role: str) -> None:
     """Tag the calling thread with a role; call first thing in run()."""
-    _roles[get_ident()] = role
+    _roles[get_ident()] = _role_prefix + role
 
 
 def unregister_current_thread() -> None:
@@ -55,7 +65,8 @@ def unregister_current_thread() -> None:
 
 
 def role_of(ident: int) -> str:
-    return _roles.get(ident, ROLE_USER)
+    role = _roles.get(ident)
+    return role if role is not None else _role_prefix + ROLE_USER
 
 
 def threads_by_role() -> Dict[str, int]:
@@ -115,5 +126,7 @@ def prune(live_idents) -> None:
 
 
 def reset_for_test() -> None:
+    global _role_prefix
     _roles.clear()
     _phases.clear()
+    _role_prefix = ""

@@ -195,6 +195,10 @@ class ServerOptions:
     # handler issuing a sync downstream RPC would deadlock the process's
     # completion loop. Off = fast requests run on a dispatch worker.
     usercode_inline: bool = False
+    # sharded dispatch plane (brpc_tpu/shard): "module:attr" naming the
+    # factory each worker process calls to build its service list. Only
+    # consulted when tpu_shard_workers > 0; None = default echo factory.
+    shard_factory: Optional[str] = None
 
 
 class Server:
@@ -224,6 +228,7 @@ class Server:
         self._method_cache = {}         # (service, method) -> MethodEntry
         self._ssl_ctx = None            # built lazily from options.ssl
         self._master_service = None     # catch-all generic service
+        self._shard_plane = None        # sharded dispatch plane (shard/)
         self.rpc_dumper = None
         if self.options.rpc_dump_dir:
             from brpc_tpu.trace.rpc_dump import RpcDumper
@@ -268,6 +273,16 @@ class Server:
         from brpc_tpu.profiling import ensure_continuous_started
 
         ensure_continuous_started()
+        from brpc_tpu import flags as _flags
+
+        if (self._shard_plane is None
+                and int(_flags.get("tpu_shard_workers")) > 0):
+            # sharded dispatch plane: worker processes spawn now so they
+            # are READY by the time the first tunnel endpoint is adopted
+            from brpc_tpu.shard.plane import ShardPlane
+
+            self._shard_plane = ShardPlane(
+                server=self, factory=self.options.shard_factory)
         if "Health" not in self._services:
             # builtin grpc.health.v1.Health (reference server.cpp:499-601
             # AddBuiltinServices / grpc_health_check_service)
@@ -454,6 +469,11 @@ class Server:
             conns = list(self._connections)
             eps = list(self._tpu_endpoints)
             self._tpu_endpoints.clear()
+        if self._shard_plane is not None:
+            # BEFORE endpoint close: leased credits must be home when the
+            # CreditLedger audits each window at teardown
+            self._shard_plane.shutdown()
+            self._shard_plane = None
         for e in eps:
             e.close()   # BYE + pool teardown; also fails the bootstrap conn
         for c in conns:
@@ -586,6 +606,8 @@ class Server:
     def _register_tpu_endpoint(self, ep) -> None:
         with self._conn_lock:
             self._tpu_endpoints.add(ep)
+        if self._shard_plane is not None:
+            self._shard_plane.adopt_endpoint(ep)
 
     def connection_count(self) -> int:
         with self._conn_lock:

@@ -1,0 +1,30 @@
+"""Default service factory for shard workers.
+
+A worker process builds its dispatch stack from a factory named as
+``"module:attr"`` in the spawn config (``ServerOptions.shard_factory``).
+The factory returns the list of Service instances to register — it runs
+INSIDE the worker, so services are constructed per-process (no pickled
+service objects cross the boundary; the cross-process-ownership lint rule
+enforces the spirit of that for the whole package).
+
+This module's ``echo_services`` is the default: the same trpc_std echo
+the benchmarks and equivalence tests speak.
+"""
+
+from __future__ import annotations
+
+from brpc_tpu.proto import echo_pb2
+from brpc_tpu.rpc import Service
+
+
+class ShardEchoService(Service):
+    DESCRIPTOR = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
+
+    def Echo(self, cntl, request, done):
+        cntl.response_attachment = cntl.request_attachment
+        return echo_pb2.EchoResponse(message=request.message,
+                                     payload=request.payload)
+
+
+def echo_services():
+    return [ShardEchoService()]

@@ -354,3 +354,49 @@ def test_record_replay_diff_smoke(tmp_path):
     finally:
         _flags.set_flag("rpc_dump_ratio", "0.0")
         _flags.set_flag("collector_max_samples_per_second", "1000")
+
+
+BASELINE_FOLDED = os.path.join(REPO, "tests", "data",
+                               "bench_profile_baseline.folded")
+
+
+def test_per_phase_cpu_ratchet_vs_baseline(profile_bench_run, capsys):
+    """The committed folded baseline gates per-phase CPU share: a live
+    --profile run must not move any phase=* synthetic root frame by more
+    than 5 percentage points of whole-process samples (measured run-to-run
+    noise on this lane is <1pp; a phase whose per-call CPU blows up shows
+    here with the phase named)."""
+    from tools import prof_diff
+
+    _, out = profile_bench_run
+    rc = prof_diff.main([BASELINE_FOLDED, str(out), "--total",
+                         "--only-prefix", "phase=",
+                         "--fail-above-pct", "5"])
+    captured = capsys.readouterr()
+    assert rc == 0, f"per-phase CPU ratchet tripped:\n{captured.out}"
+
+
+def test_per_phase_ratchet_names_moved_phase(tmp_path, capsys):
+    """Sensitivity check, no live run needed: inflate the baseline's
+    phase=parse stacks 9x and the ratchet must exit 1 with the moved
+    phase ranked as the top mover."""
+    from tools import prof_diff
+
+    doctored = []
+    for line in open(BASELINE_FOLDED, encoding="utf-8"):
+        stack, _, weight = line.rstrip("\n").rpartition(" ")
+        if ";phase=parse;" in stack:
+            weight = str(int(weight) * 9)
+        doctored.append(f"{stack} {weight}")
+    bad = tmp_path / "doctored.folded"
+    bad.write_text("\n".join(doctored) + "\n")
+    rc = prof_diff.main([BASELINE_FOLDED, str(bad), "--total",
+                         "--only-prefix", "phase=",
+                         "--fail-above-pct", "5", "--json"])
+    assert rc == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["movers"], report
+    assert report["movers"][0]["frame"] == "phase=parse", report["movers"]
+    assert report["movers"][0]["delta_pct"] > 5, report["movers"][0]
+    # the filter keeps the ratchet to the synthetic phase frames only
+    assert all(m["frame"].startswith("phase=") for m in report["movers"])

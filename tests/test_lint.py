@@ -16,7 +16,7 @@ REPO = Path(__file__).resolve().parent.parent
 EXPECTED_RULES = {
     "no-blocking-in-poller", "acquire-release", "monotonic-clock",
     "lock-order", "version-guard", "metric-flag-hygiene", "bounded-spin",
-    "named-thread",
+    "named-thread", "cross-process-ownership",
 }
 
 
@@ -545,3 +545,81 @@ def test_cli_list_rules():
     assert out.returncode == 0
     for rule in EXPECTED_RULES:
         assert rule in out.stdout
+
+
+class TestCrossProcessOwnership:
+    RULE = ["cross-process-ownership"]
+
+    def test_pickle_import_flagged(self, tmp_path):
+        res = _lint(tmp_path, {"shard/bad.py": """\
+            import pickle
+            def ship(ring, obj):
+                ring.push(1, pickle.dumps(obj))
+            """}, rules=self.RULE)
+        assert [f.rule for f in res.findings] == ["cross-process-ownership"]
+
+    def test_from_pickle_import_flagged(self, tmp_path):
+        res = _lint(tmp_path, {"shard/bad.py": """\
+            from pickle import dumps
+            """}, rules=self.RULE)
+        assert not res.clean
+
+    def test_mp_queue_import_flagged(self, tmp_path):
+        res = _lint(tmp_path, {"shard/bad.py": """\
+            from multiprocessing import Queue
+            """}, rules=self.RULE)
+        assert not res.clean
+        assert "flat bytes" in res.findings[0].message
+
+    def test_mp_queue_call_flagged(self, tmp_path):
+        res = _lint(tmp_path, {"shard/bad.py": """\
+            import multiprocessing
+            def mk():
+                return multiprocessing.Queue()
+            """}, rules=self.RULE)
+        assert not res.clean
+
+    def test_tainted_iobuf_to_push_flagged(self, tmp_path):
+        res = _lint(tmp_path, {"shard/bad.py": """\
+            from brpc_tpu.butil.iobuf import IOBuf
+            def ship(ring, data):
+                packet = IOBuf(data)
+                ring.push(3, packet)
+            """}, rules=self.RULE)
+        assert not res.clean
+        assert "packet" in res.findings[0].message
+
+    def test_owned_attr_to_send_flagged(self, tmp_path):
+        res = _lint(tmp_path, {"shard/bad.py": """\
+            def ship(conn, sock):
+                buf = sock.read_buf
+                conn.send(buf)
+            """}, rules=self.RULE)
+        assert not res.clean
+
+    def test_shared_memory_import_allowed(self, tmp_path):
+        res = _lint(tmp_path, {"shard/ok.py": """\
+            from multiprocessing import shared_memory, resource_tracker
+            def attach(name):
+                seg = shared_memory.SharedMemory(name=name)
+                resource_tracker.unregister("/" + name, "shared_memory")
+                return seg
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_handles_and_indices_pass(self, tmp_path):
+        res = _lint(tmp_path, {"shard/ok.py": """\
+            import struct
+            def ship(ring, name, indices, total):
+                body = struct.pack("!I", total) + name.encode()
+                ring.push(7, body)
+                ring.push(8, struct.pack(f"!{len(indices)}I", *indices))
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_outside_shard_scope_ignored(self, tmp_path):
+        # the contract binds shard/ only; transport may pickle for dumps
+        res = _lint(tmp_path, {"tpu/other.py": """\
+            import pickle
+            """}, rules=self.RULE)
+        assert res.clean

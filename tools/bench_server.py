@@ -144,13 +144,22 @@ def main(argv=None):
                     help="answer Echo as the null-service CONTROL: raw "
                          "body echo from the poll loop, no policy "
                          "(bench ceiling isolation, VERDICT r4 #2a)")
+    ap.add_argument("--shard-workers", type=int, default=0,
+                    help="spread dispatch over N worker processes "
+                         "(brpc_tpu/shard sharded dispatch plane; the "
+                         "workers serve the same trpc_std echo)")
     args = ap.parse_args(argv)
     if args.null and not args.native:
         ap.error("--null requires --native (the control lane lives in "
                  "the native poll loop; without it you would measure the "
                  "full-policy path and call it the ceiling)")
-    server = Server(ServerOptions(native_dataplane=args.native,
-                                  usercode_inline=args.inline))
+    if args.shard_workers > 0:
+        from brpc_tpu import flags
+
+        flags.set_flag("tpu_shard_workers", args.shard_workers)
+    server = Server(ServerOptions(
+        native_dataplane=args.native, usercode_inline=args.inline,
+        shard_factory="brpc_tpu.shard.testing:echo_services"))
     stream_impl = None
     if args.device:
         from brpc_tpu.tpu.device_lane import DeviceDataService
@@ -171,6 +180,10 @@ def main(argv=None):
         server.register_native_echo("EchoService", "Echo")
     if args.null:
         server.register_null_method("EchoService", "Echo")
+    if args.shard_workers > 0 and server._shard_plane is not None:
+        # don't print LISTEN until the workers can take traffic — the
+        # sweep must measure the plane, not worker interpreter boot
+        server._shard_plane.wait_ready(30.0)
     print(f"LISTEN {server.listen_endpoint()}", flush=True)
     try:
         sys.stdin.read()  # parent closing the pipe is the stop signal

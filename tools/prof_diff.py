@@ -19,6 +19,8 @@ Examples:
     python tools/prof_diff.py base.folded 127.0.0.1:8000 --top 10
     python tools/prof_diff.py a.folded b.folded --total --json
     python tools/prof_diff.py a.folded b.folded --fail-above-pct 5
+    python tools/prof_diff.py base.folded new.folded --total \\
+        --only-prefix phase= --fail-above-pct 15   # per-phase ratchet
 """
 
 from __future__ import annotations
@@ -65,6 +67,10 @@ def main(argv=None) -> int:
     p.add_argument("--total", action="store_true",
                    help="rank by total (frame-anywhere-on-stack) share "
                         "instead of self (leaf) share")
+    p.add_argument("--only-prefix", default="",
+                   help="rank only frames starting with this prefix "
+                        "('phase=' with --total = per-phase CPU ratchet "
+                        "over the synthetic root frames)")
     p.add_argument("--seconds", type=float, default=1.0,
                    help="profile duration when a source is a live "
                         "host:port (default 1)")
@@ -84,7 +90,8 @@ def main(argv=None) -> int:
 
     report = _diff.diff_folded(
         base, new, top=args.top, min_delta_pct=args.min_delta_pct,
-        mode="total" if args.total else "self")
+        mode="total" if args.total else "self",
+        only_prefix=args.only_prefix)
     if args.json:
         print(json.dumps(report, indent=2))
     else:
