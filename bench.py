@@ -280,6 +280,20 @@ def bench_tpu_sweep():
         srv.close()
 
 
+def measure_series_overhead() -> float:
+    """Cost of one series-ring sweep over this process's exposed vars
+    (metrics/series.py), as a percentage of the 1s tick budget the
+    sampler daemon grants it. Measured on a private registry so the
+    probe never perturbs the live rings."""
+    from brpc_tpu.metrics.series import SeriesRegistry
+
+    reg = SeriesRegistry()
+    for _ in range(50):
+        reg.tick()
+    avg_s = reg.total_tick_s / max(reg.ticks, 1)
+    return avg_s * 100.0
+
+
 def bench_batch_lane():
     """Adaptive batching (brpc_tpu/batch/) head to head with per-request
     dispatch: the same jitted MLP behind BatchBench.Infer (one B=1 jit call
@@ -1065,9 +1079,13 @@ def main() -> None:
         bench_hybrid_native()
     if _phase_enabled("batch"):
         bench_batch_lane()
-    py_1mb = py_64b_qps = None
+    py_1mb = py_64b_qps = series_pct = None
     if _phase_enabled("shm"):
         py_1mb, py_64b_qps = bench_tpu_sweep()
+        series_pct = measure_series_overhead()
+        print(f"# vars series sampler overhead: {series_pct:.4f}% of the "
+              f"1s tick budget (one ring sweep over this process's "
+              f"exposed vars)", file=sys.stderr)
     if os.environ.get("BENCH_SKIP_DEVICE") != "1" and \
             _phase_enabled("device"):
         try:
@@ -1114,6 +1132,12 @@ def main() -> None:
             "value": round(py_64b_qps, 1),
             "unit": "qps",
             "vs_baseline": round(py_64b_qps / BASELINE_64B_QPS, 3),
+        }))
+    if series_pct is not None:
+        print(json.dumps({
+            "metric": "vars_series_overhead_pct",
+            "value": round(series_pct, 4),
+            "unit": "%",
         }))
 
 

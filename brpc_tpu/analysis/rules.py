@@ -779,3 +779,61 @@ def rule_cross_process_ownership(pkg: Package) -> List[Finding]:
                             f"byte lengths may cross the process "
                             f"boundary"))
     return out
+
+
+# --------------------------------------------------------------------------
+# Rule 10: metric-churn
+# --------------------------------------------------------------------------
+# Metric construction is deliberately expensive relative to metric updates:
+# a Reducer allocates TLS agent machinery, expose() takes the registry lock,
+# Window/PerSecond register a Sampler with the daemon — and since PR 12 every
+# exposed var also grows a series ring swept once per second. Constructing
+# (or exposing) one inside a request-path function churns allocations per
+# RPC and can grow the registry without bound. Vars must be module-level or
+# cached per method (rpc/server.py's MethodEntry lazy-expose pattern, which
+# is guarded by a flag and runs once — server.py is deliberately outside
+# this rule's scope).
+
+_CHURN_MODULES = {
+    "rpc/server_processing.py", "rpc/input_messenger.py",
+    "rpc/event_dispatcher.py", "rpc/run_to_completion.py",
+    "rpc/native_transport.py", "tpu/transport.py",
+    "batch/runtime.py", "batch/queue.py", "shard/worker.py",
+}
+
+_CHURN_CTORS = {"Adder", "Maxer", "Miner", "LatencyRecorder", "IntRecorder",
+                "Window", "PerSecond", "WindowedPercentile", "MultiDimension",
+                "Status", "PassiveStatus"}
+
+
+@register_rule(
+    "metric-churn",
+    "no metric construction (Adder/LatencyRecorder/Window/...) or expose() "
+    "inside request-path functions (dispatch/transport/batch modules) — "
+    "vars must be module-level or cached per method")
+def rule_metric_churn(pkg: Package) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in pkg.files:
+        if not in_scope(sf.rel, exact=_CHURN_MODULES):
+            continue
+        for func, cls in iter_functions(sf.tree):
+            where = f"{cls}.{func.name}" if cls else func.name
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                last = _call_last_name(node)
+                if last in _CHURN_CTORS:
+                    out.append(Finding(
+                        "metric-churn", sf.rel, node.lineno,
+                        f"{last}(...) constructed inside request-path "
+                        f"function {where}() — metric construction "
+                        f"allocates TLS agents/samplers per call; hoist "
+                        f"to module level or cache per method"))
+                elif last in ("expose", "expose_as"):
+                    out.append(Finding(
+                        "metric-churn", sf.rel, node.lineno,
+                        f".{last}(...) inside request-path function "
+                        f"{where}() — exposing takes the registry lock "
+                        f"and grows /vars (and its series rings) per "
+                        f"call; expose once at module scope"))
+    return out

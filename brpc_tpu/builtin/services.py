@@ -113,15 +113,67 @@ def status_service(server, http: HttpMessage):
 
 
 # ----------------------------------------------------------------------- vars
+CONTENT_SVG = "image/svg+xml"
+
+
 def vars_service(server, http: HttpMessage):
+    from brpc_tpu.metrics.series import global_series
+
     name = _sub_path(http)
     snapshot = dump_exposed()
     if name:
         if name not in snapshot:
             return 404, CONTENT_TEXT, f"no var {name!r}\n"
-        return 200, CONTENT_TEXT, f"{name} : {snapshot[name]}\n"
+        series = global_series().get(name)
+        sd = series.to_dict() if series is not None else None
+        if http.query.get("series") == "json":
+            if sd is None:
+                return 404, CONTENT_TEXT, f"no series for {name!r}\n"
+            return 200, CONTENT_JSON, json.dumps({name: sd}) + "\n"
+        if http.query.get("format") == "svg":
+            from brpc_tpu.builtin.series_plot import var_svg
+
+            if sd is None:
+                return 404, CONTENT_TEXT, f"no series for {name!r}\n"
+            return 200, CONTENT_SVG, var_svg(name, sd)
+        if _wants_html(http):
+            from brpc_tpu.builtin.series_plot import detail_page_html
+
+            return 200, CONTENT_HTML, detail_page_html(
+                name, str(snapshot[name]), sd)
+        out = f"{name} : {snapshot[name]}\n"
+        if sd is not None:
+            sec = sd["second"]
+            out += (f"series : {sd['count']} samples, "
+                    f"last={sd['last']} "
+                    f"1s[-10:]={sec[-10:]} (?series=json, ?format=svg)\n")
+        return 200, CONTENT_TEXT, out
+    if http.query.get("series") == "json":
+        glob = http.query.get("name", "*")
+        dump = global_series().dump(glob)
+        return 200, CONTENT_JSON, json.dumps(
+            {"workers": getattr(server, "shard_worker_count", 0)
+             if server is not None else 0,
+             "series": dump}) + "\n"
     body = "".join(f"{k} : {v}\n" for k, v in snapshot.items())
     return 200, CONTENT_TEXT, body
+
+
+# ---------------------------------------------------------------------- watch
+def watch_service(server, http: HttpMessage):
+    from brpc_tpu.metrics.watch import global_watch
+
+    rules = global_watch().rules()
+    if http.query.get("format") == "json":
+        return 200, CONTENT_JSON, json.dumps(
+            {"rules": [r.to_dict() for r in rules]}, indent=2) + "\n"
+    if not rules:
+        return 200, CONTENT_TEXT, "no watch rules installed\n"
+    lines = [f"{'state':8} {'rule':28} {'observed':>12}  condition"]
+    for r in rules:
+        lines.append(f"{r.state:8} {r.name:28} {r.observed:>12.4g}  "
+                     f"{r.condition()}")
+    return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
 
 
 # ----------------------------------------------------------------------- vlog
@@ -591,7 +643,10 @@ def logoff_service(server, http: HttpMessage):
 
 register_builtin("index", index_service, "this page")
 register_builtin("status", status_service, "server + per-method stats")
-register_builtin("vars", vars_service, "all exposed metrics (/vars/<name>)")
+register_builtin("vars", vars_service,
+                 "all exposed metrics (/vars/<name>, ?series=json&name=glob)")
+register_builtin("watch", watch_service,
+                 "watch rules over series rings (?format=json)")
 register_builtin("flags", flags_service,
                  "runtime flags (/flags/<name>?setvalue=v)")
 register_builtin("connections", connections_service, "accepted connections")

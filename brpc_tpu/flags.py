@@ -261,3 +261,7 @@ tpu_shard_forward_max = define(
     "requests larger than this stay on the in-process dispatch path "
     "(forwarding copies the frame through the shm ring once)",
     validator=_positive)
+shard_vars_interval_s = define(
+    "shard_vars_interval_s", 1.0,
+    "seconds between W_VARS windowed var snapshots a shard worker ships "
+    "to the parent for fleet-wide /vars aggregation", validator=_positive)

@@ -18,6 +18,19 @@ from brpc_tpu.metrics.status import (
     PassiveStatus,
     prometheus_text,
 )
+from brpc_tpu.metrics.series import (
+    VarSeries,
+    SeriesRegistry,
+    global_series,
+    ensure_series_installed,
+)
+from brpc_tpu.metrics.watch import (
+    WatchRule,
+    WatchRegistry,
+    global_watch,
+    ensure_watch_hooked,
+    install_default_rules,
+)
 
 __all__ = [
     "Variable",
@@ -44,5 +57,14 @@ __all__ = [
     "PassiveStatus",
     "MultiDimension",
     "prometheus_text",
+    "VarSeries",
+    "SeriesRegistry",
+    "global_series",
+    "ensure_series_installed",
+    "WatchRule",
+    "WatchRegistry",
+    "global_watch",
+    "ensure_watch_hooked",
+    "install_default_rules",
 ]
 from brpc_tpu.metrics.multi_dimension import MultiDimension  # noqa: E402,F401

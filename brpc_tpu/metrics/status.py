@@ -45,6 +45,11 @@ def _escape_label(v: str) -> str:
         .replace("\n", "\\n")
 
 
+def _escape_help(v: str) -> str:
+    # HELP lines escape backslash and newline only (exposition format)
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def prometheus_text() -> str:
     """Render every exposed variable in Prometheus exposition format.
     MultiDimension families render one labeled sample per combination
@@ -55,11 +60,15 @@ def prometheus_text() -> str:
     for name, var in exposed_variables():
         metric = name.replace(".", "_").replace("-", "_")
         mtype = getattr(var, "prometheus_type", "gauge")
+        help_text = getattr(var, "prometheus_help", None)
         samples = getattr(var, "prometheus_samples", None)
         if samples is not None:
             rendered = False
             for labels, num in samples():
                 if not rendered:
+                    if help_text:
+                        lines.append(f"# HELP {metric} "
+                                     f"{_escape_help(help_text)}")
                     lines.append(f"# TYPE {metric} {mtype}")
                     rendered = True
                 lbl = ",".join(
@@ -71,6 +80,8 @@ def prometheus_text() -> str:
             num = float(var.describe())
         except (TypeError, ValueError):
             continue  # prometheus only carries numeric samples
+        if help_text:
+            lines.append(f"# HELP {metric} {_escape_help(help_text)}")
         lines.append(f"# TYPE {metric} {mtype}")
         lines.append(f"{metric} {num:g}")
     return "\n".join(lines) + ("\n" if lines else "")

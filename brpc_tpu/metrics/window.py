@@ -54,6 +54,31 @@ class Window(Generic[T]):
     def get_span_seconds(self) -> int:
         return min(self._sampler.sample_count(), self.window_size) or 1
 
+    def expose(self, name: str) -> "Window":
+        from brpc_tpu.metrics.variable import Variable
+
+        win = self
+
+        class _Wrap(Variable):
+            def __init__(w):
+                super().__init__()
+                # a windowed reading is a point-in-time value: always a
+                # gauge, even when the underlying reducer is a monotonic
+                # counter (scraping it as a counter would make rate() of
+                # an already-rated value)
+                w.prometheus_type = "gauge"
+
+            def get_value(w):
+                return win.get_value()
+
+        self._var = _Wrap().expose(name)
+        return self
+
+    def hide(self) -> None:
+        var = getattr(self, "_var", None)
+        if var is not None:
+            var.hide()
+
 
 class PerSecond(Window):
     def get_value(self):

@@ -235,6 +235,13 @@ class Server:
 
             self.rpc_dumper = RpcDumper(self.options.rpc_dump_dir)
 
+    @property
+    def shard_worker_count(self) -> int:
+        """Shard workers currently reporting W_VARS snapshots (the
+        ``workers=N`` of the fleet-aggregated /vars view)."""
+        plane = self._shard_plane
+        return plane.fleet.workers_reporting() if plane is not None else 0
+
     # -------------------------------------------------------------- services
     def set_master_service(self, service: "Service") -> "Server":
         """Catch-all untyped service (reference baidu_master_service.cpp):
@@ -273,6 +280,17 @@ class Server:
         from brpc_tpu.profiling import ensure_continuous_started
 
         ensure_continuous_started()
+        # series rings + watch rules ride the same sampler daemon: one
+        # O(vars) append per second, gated by var_series_enabled
+        from brpc_tpu.metrics.series import ensure_series_installed
+        from brpc_tpu.metrics.watch import (
+            ensure_watch_hooked,
+            install_default_rules,
+        )
+
+        ensure_series_installed()
+        ensure_watch_hooked()
+        install_default_rules()
         from brpc_tpu import flags as _flags
 
         if (self._shard_plane is None

@@ -47,6 +47,7 @@ from brpc_tpu.metrics.reducer import Adder
 from brpc_tpu.profiling import registry as _prof
 from brpc_tpu.rpc import errors
 from brpc_tpu.shard import wire
+from brpc_tpu.shard.fleet import FleetVars
 from brpc_tpu.shard.ring import ShardRing
 from brpc_tpu.shard.subwindow import LeaseManager
 
@@ -61,11 +62,23 @@ g_shard_respawns = Adder("g_shard_respawns")
 
 
 def shard_for(cid: int, n: int) -> int:
-    """cid -> worker index. Knuth multiplicative hash over the correlation
-    id (sequential ids from one channel must spread, not clump), stable
-    across processes and runs — routing stability is load-bearing: a
-    retry re-issued with the same cid lands on the same worker."""
-    return ((cid * 2654435761) >> 13) % n
+    """cid -> worker index, stable across processes and runs — routing
+    stability is load-bearing: a retry re-issued with the same cid lands
+    on the same worker.
+
+    Full splitmix64 avalanche, not a bare multiplicative hash: real cids
+    from a low-concurrency channel are ``version << 32`` (VersionedPool
+    reuses slot 0, only the high-bits version advances), so any scheme
+    that reads a fixed bit range of ``cid * K`` sees a constant — the
+    original Knuth hash pinned every request of a sequential client to
+    worker 0."""
+    h = cid & 0xFFFFFFFFFFFFFFFF
+    h ^= h >> 30
+    h = (h * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    h ^= h >> 27
+    h = (h * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    h ^= h >> 31
+    return h % n
 
 
 class WorkerHandle:
@@ -201,6 +214,7 @@ class ShardPlane:
         self.fallback = 0
         self.fanin_batches = 0
         self.fanin_frames = 0
+        self.fleet = FleetVars()
         for w in self.workers:
             self._spawn(w)
         self._collector_t = threading.Thread(
@@ -226,7 +240,9 @@ class ShardPlane:
                       for name in ("rtc_enable", "rtc_budget_us",
                                    "rtc_cheap_us", "rtc_max_body",
                                    "stream_body_min_bytes",
-                                   "max_body_size")},
+                                   "max_body_size",
+                                   "shard_vars_interval_s",
+                                   "var_series_enabled")},
         }
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
@@ -397,6 +413,8 @@ class ShardPlane:
             w.stats = json.loads(payload.decode())
         elif rtype == wire.W_PROF:
             w.prof_lines = payload.decode()
+        elif rtype == wire.W_VARS:
+            self.fleet.on_snapshot(w.index, payload)
 
     @staticmethod
     def _read_spill(name: str, total: int) -> Optional[bytes]:
@@ -587,6 +605,7 @@ class ShardPlane:
         self._collector_t.join(timeout=1.0)
         self._monitor_t.join(timeout=1.0)
         self._drain_once()
+        self.fleet.hide_all()
         for lane in list(self.lanes.values()):
             if lane.lm is not None:
                 lane.lm.release_all()

@@ -36,6 +36,7 @@ W_LEASE_REQUEST = 36 # !II ep_id want
 W_STATS = 37         # utf-8 json
 W_PROF = 38          # utf-8 folded stack lines
 W_RESP_SHM = 39      # !IQQ ep_id cid total + utf-8 spill segment name
+W_VARS = 40          # utf-8 json windowed var snapshot (shard/fleet.py)
 
 _II = struct.Struct("!II")
 _I = struct.Struct("!I")

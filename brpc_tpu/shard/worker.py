@@ -47,7 +47,8 @@ _I = struct.Struct("!I")
 # stack in here must classify/inline exactly like the parent's would
 _FLAG_ALLOWLIST = (
     "rtc_enable", "rtc_budget_us", "rtc_cheap_us", "rtc_max_body",
-    "stream_body_min_bytes", "max_body_size",
+    "stream_body_min_bytes", "max_body_size", "shard_vars_interval_s",
+    "var_series_enabled",
 )
 
 STATS_INTERVAL_S = 0.5
@@ -373,7 +374,11 @@ class ShardWorker:
         idle_sleep = 0.0
         last_stats = _time.monotonic()
         last_prof = last_stats
+        last_vars = last_stats
         last_prof_ts = _time.time()
+        from brpc_tpu import flags as _flags
+        from brpc_tpu.shard.fleet import worker_snapshot
+        vars_interval = float(_flags.get("shard_vars_interval_s"))
         while not self._quit:
             recs = self.in_ring.pop(64)
             if recs:
@@ -398,6 +403,15 @@ class ShardWorker:
                 if lines:
                     with self._out_lock:
                         self.out_ring.push(wire.W_PROF, lines)
+            if now - last_vars >= vars_interval:
+                last_vars = now
+                try:
+                    snap = worker_snapshot(self.index)
+                except Exception:
+                    snap = b""
+                if snap:
+                    with self._out_lock:
+                        self.out_ring.push(wire.W_VARS, snap)
         for wep in self.eps.values():
             if wep.sub is not None:
                 wep.sub.close()

@@ -35,7 +35,8 @@ def bench_run():
 def test_headline_json(bench_run):
     lines = [l for l in bench_run.stdout.splitlines()
              if l.startswith("{")]
-    assert len(lines) == 2, bench_run.stdout
+    # headline + 64B qps + vars series overhead
+    assert len(lines) == 3, bench_run.stdout
     headline = json.loads(lines[0])
     assert headline["metric"] == "echo_1mb_framework_bandwidth"
     assert headline["unit"] == "GB/s"
@@ -51,6 +52,32 @@ def test_small_message_qps_json(bench_run):
     assert small[0]["unit"] == "qps"
     assert small[0]["value"] > 0, small[0]
     assert small[0]["vs_baseline"] > 0, small[0]
+
+
+def test_vars_series_overhead_metric(bench_run):
+    """The shm sweep must emit the series-ring overhead metric, and one
+    ring sweep must stay far inside the sampler's 1s tick budget."""
+    rows = [json.loads(l) for l in bench_run.stdout.splitlines()
+            if l.startswith("{")]
+    m = [r for r in rows if r["metric"] == "vars_series_overhead_pct"]
+    assert len(m) == 1, bench_run.stdout
+    assert m[0]["unit"] == "%"
+    assert 0 <= m[0]["value"] < 2.0, m[0]
+
+
+def test_method_qps_series_nonempty_after_sweep(bench_run):
+    """By the end of the shm sweep the bench server's per-method qps var
+    must have accumulated live 1-second series samples (the sampler
+    daemon sweeps rings while traffic flows)."""
+    lines = [l for l in bench_run.stderr.splitlines()
+             if l.startswith(
+                 "# vars series rpc_method_echoservice_echo_qps")]
+    assert lines, bench_run.stderr[-2000:]
+    line = lines[0]
+    count = int(line.split("count=")[1].split(" ")[0])
+    nonzero = int(line.split("nonzero_1s=")[1].split(" ")[0])
+    assert count >= 1, line
+    assert nonzero >= 1, line
 
 
 def test_rtc_lane_activates_on_shm_sweep(bench_run):
@@ -243,6 +270,12 @@ def test_sampler_overhead_under_two_pct_at_default_hz():
 
     hz = float(_flags.get("tpu_prof_continuous_hz"))
     assert hz > 0
+    # the guard must cover the series plane: Server.start installs the
+    # ring sweep on the same 1s sampler daemon the guard exercises
+    from brpc_tpu.metrics.series import global_series
+
+    assert _flags.get("var_series_enabled")
+    ticks_before = global_series().ticks
     srv = Server().add_service(EchoImpl()).start("tpu://127.0.0.1:0/0")
     try:
         ch = Channel(ChannelOptions(protocol="trpc_std", timeout_ms=10000))
@@ -264,6 +297,13 @@ def test_sampler_overhead_under_two_pct_at_default_hz():
     assert overhead < 0.02, (
         f"sampler self-time {overhead:.2%} of wall at {hz:g}hz "
         f"({prof.ticks} ticks, sample_time={prof.sample_time_s:.4f}s)")
+    # the series sweep ran during the window and its own cost stays far
+    # inside the 1s tick budget (same <2% bar as the profiler)
+    series = global_series()
+    assert series.ticks > ticks_before, "series rings never ticked"
+    avg_tick = series.total_tick_s / max(series.ticks, 1)
+    assert avg_tick < 0.02, (
+        f"series ring sweep averages {avg_tick * 1e3:.2f}ms per 1s tick")
 
 
 def test_record_replay_diff_smoke(tmp_path):

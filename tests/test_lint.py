@@ -16,7 +16,7 @@ REPO = Path(__file__).resolve().parent.parent
 EXPECTED_RULES = {
     "no-blocking-in-poller", "acquire-release", "monotonic-clock",
     "lock-order", "version-guard", "metric-flag-hygiene", "bounded-spin",
-    "named-thread", "cross-process-ownership",
+    "named-thread", "cross-process-ownership", "metric-churn",
 }
 
 
@@ -623,3 +623,72 @@ class TestCrossProcessOwnership:
             import pickle
             """}, rules=self.RULE)
         assert res.clean
+
+
+# --------------------------------------------------------- metric-churn
+class TestMetricChurn:
+    RULE = ["metric-churn"]
+
+    def test_adder_in_dispatch_function_fires(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/server_processing.py": """\
+            from brpc_tpu.metrics.reducer import Adder
+            def process_rpc_request(server, sock, msg):
+                errors = Adder("g_oops_per_request")
+                errors.put(1)
+            """}, rules=self.RULE)
+        assert [f.rule for f in res.findings] == ["metric-churn"]
+        assert "Adder" in res.findings[0].message
+
+    def test_latency_recorder_in_transport_method_fires(self, tmp_path):
+        res = _lint(tmp_path, {"tpu/transport.py": """\
+            from brpc_tpu.metrics.latency_recorder import LatencyRecorder
+            class TpuEndpoint:
+                def on_data(self, frame):
+                    rec = LatencyRecorder()
+                    rec.record(1)
+            """}, rules=self.RULE)
+        assert not res.clean
+        assert "TpuEndpoint.on_data" in res.findings[0].message
+
+    def test_expose_in_batch_function_fires(self, tmp_path):
+        res = _lint(tmp_path, {"batch/runtime.py": """\
+            def flush(self, batch):
+                self._qps_var.expose("g_batch_qps")
+            """}, rules=self.RULE)
+        assert not res.clean
+        assert "expose" in res.findings[0].message
+
+    def test_window_in_worker_loop_fires(self, tmp_path):
+        res = _lint(tmp_path, {"shard/worker.py": """\
+            from brpc_tpu.metrics.window import Window
+            def run(self):
+                while True:
+                    w = Window(self._adder, 10)
+            """}, rules=self.RULE)
+        assert not res.clean
+
+    def test_module_level_construction_passes(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/server_processing.py": """\
+            from brpc_tpu.metrics.reducer import Adder
+            g_requests = Adder("g_requests")
+            def process_rpc_request(server, sock, msg):
+                g_requests.put(1)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_same_code_outside_scope_passes(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/server.py": """\
+            from brpc_tpu.metrics.latency_recorder import LatencyRecorder
+            def on_response(self):
+                rec = LatencyRecorder()
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_suppression_honored(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/event_dispatcher.py": """\
+            from brpc_tpu.metrics.reducer import Adder
+            def __init__(self):
+                self.n = Adder()  # tpulint: disable=metric-churn
+            """}, rules=self.RULE)
+        assert res.clean
+        assert len(res.suppressed) == 1

@@ -227,10 +227,21 @@ class TestRouting:
             hits[shard_for(cid, 2)] += 1
         assert 0.35 < hits[0] / 2000 < 0.65, hits
 
+    def test_versioned_cids_spread_over_two_workers(self):
+        """Real cids from a low-concurrency channel are ``version << 32``:
+        VersionedPool reuses slot 0 and only the odd version advances.
+        The original Knuth hash mapped ALL of these to worker 0."""
+        hits = [0, 0]
+        for v in range(1, 4001, 2):
+            hits[shard_for(v << 32, 2)] += 1
+        assert 0.35 < hits[0] / 2000 < 0.65, hits
+
     def test_every_worker_reached(self):
         for n in (2, 3, 4, 7):
             seen = {shard_for(cid, n) for cid in range(1, 512)}
             assert seen == set(range(n)), (n, seen)
+            seen = {shard_for(v << 32, n) for v in range(1, 129, 2)}
+            assert seen == set(range(n)), ("versioned", n, seen)
 
 
 # ------------------------------------------------------------------ leases

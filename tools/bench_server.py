@@ -205,6 +205,16 @@ def main(argv=None):
           f"inline_responses={st['inline_responses']} "
           f"demotions={st['demotions']} {per_method}",
           file=sys.stderr, flush=True)
+    # series-ring report: the per-method qps rings the sampler daemon
+    # accumulated while the sweep ran (test_bench_quick asserts these are
+    # non-empty after the shm phase)
+    from brpc_tpu.metrics.series import global_series
+
+    for name, d in sorted(global_series().dump("rpc_method_*_qps").items()):
+        nonzero = sum(1 for v in d["second"] if v)
+        print(f"# vars series {name}: count={d['count']} "
+              f"nonzero_1s={nonzero} last={d['last']}",
+              file=sys.stderr, flush=True)
     return 0
 
 

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""vars_view — terminal sparklines for /vars series rings.
+
+Input is the ``/vars?series=json`` payload, from a live server or a file::
+
+    python tools/vars_view.py --fetch 127.0.0.1:8000 --name 'rpc_method_*'
+    curl -s host:port/vars?series=json | python tools/vars_view.py -
+    python tools/vars_view.py snapshot.json --tier minute
+
+Each matching var renders one line: a unicode sparkline over the chosen
+tier (second by default) plus min/max/last. ``--watch`` clears the screen
+and refreshes every ``--interval`` seconds (live fetch only).
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import sys
+import time
+import urllib.request
+
+SPARKS = "▁▂▃▄▅▆▇█"
+TIERS = ("second", "minute", "hour")
+
+
+def sparkline(values) -> str:
+    if not values:
+        return ""
+    lo = min(values)
+    hi = max(values)
+    span = (hi - lo) or 1
+    return "".join(
+        SPARKS[int((v - lo) / span * (len(SPARKS) - 1))] for v in values)
+
+
+def _fmt(value, is_float: bool) -> str:
+    if is_float:
+        return f"{value:.4g}"
+    return str(int(value))
+
+
+def render(doc: dict, name_glob: str, tier: str) -> str:
+    series = doc.get("series", doc)  # accept both wrapped and bare dumps
+    out = []
+    workers = doc.get("workers", 0)
+    if workers:
+        out.append(f"# workers={workers}")
+    names = [n for n in sorted(series) if fnmatch.fnmatchcase(n, name_glob)]
+    if not names:
+        return "no vars match\n"
+    width = max(len(n) for n in names)
+    for name in names:
+        sd = series[name]
+        values = sd.get(tier, [])
+        is_float = sd.get("float", False)
+        lo = min(values) if values else 0
+        hi = max(values) if values else 0
+        last = sd.get("last", 0)
+        out.append(
+            f"{name:<{width}} {sparkline(values)} "
+            f"min={_fmt(lo, is_float)} max={_fmt(hi, is_float)} "
+            f"last={_fmt(last, is_float)}")
+    return "\n".join(out) + "\n"
+
+
+def fetch(host_port: str, name_glob: str, timeout: float = 5.0) -> dict:
+    url = f"http://{host_port}/vars?series=json&name={name_glob}"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("input", nargs="?", default=None,
+                    help="series=json file, or - for stdin")
+    ap.add_argument("--fetch", metavar="HOST:PORT",
+                    help="fetch live from a server's /vars?series=json")
+    ap.add_argument("--name", default="*", help="var name glob")
+    ap.add_argument("--tier", default="second", choices=TIERS)
+    ap.add_argument("--watch", action="store_true",
+                    help="refresh loop (with --fetch)")
+    ap.add_argument("--interval", type=float, default=1.0)
+    args = ap.parse_args(argv)
+
+    if args.fetch is None and args.input is None:
+        ap.error("need an input file, -, or --fetch host:port")
+    if args.watch and args.fetch is None:
+        ap.error("--watch needs --fetch")
+
+    while True:
+        if args.fetch is not None:
+            doc = fetch(args.fetch, args.name)
+        elif args.input == "-":
+            doc = json.loads(sys.stdin.read())
+        else:
+            with open(args.input) as f:
+                doc = json.load(f)
+        body = render(doc, args.name, args.tier)
+        if args.watch:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        sys.stdout.write(body)
+        sys.stdout.flush()
+        if not args.watch:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
