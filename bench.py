@@ -381,6 +381,167 @@ def bench_batch_lane():
         srv.close()
 
 
+def _serving_engine_qps(scheduling: str, n_requests: int) -> float:
+    """In-process half of the serving lane: one engine, one mixed-length
+    workload (mostly short 4-token generations with a long 64-token one
+    every 4th request — each static gang carries exactly one straggler;
+    all submitted up front); returns requests/sec. Static gang scheduling
+    drains a whole batch before admitting the next, so every short
+    request waits out the longest gang member; continuous batching
+    refills freed slots between decode steps (brpc_tpu/serving/engine.py).
+    Identical model/engine configs, so the ratio isolates the scheduler."""
+    from brpc_tpu.serving import (EngineConfig, KVCacheConfig, ModelConfig,
+                                  PagedKVCache, ServingEngine,
+                                  TinyTransformer)
+
+    cfg = ModelConfig(vocab=256, d_model=32, n_heads=2, n_layers=2)
+    kv = PagedKVCache(KVCacheConfig(block_size=16, num_blocks=256),
+                      cfg.n_layers, cfg.kv_dim)
+    model = TinyTransformer(cfg, kv)
+    engine = ServingEngine(model, kv, EngineConfig(
+        max_batch=4, token_budget=256, scheduling=scheduling,
+        idle_wait_s=0.005)).start()
+
+    def run(n):
+        evs = []
+        t0 = time.perf_counter()
+        for i in range(n):
+            ev = threading.Event()
+            code, _ = engine.submit(model.synth_prompt(16),
+                                    64 if i % 4 == 3 else 4,
+                                    done=lambda _r, ev=ev: ev.set())
+            if code != 0:
+                raise RuntimeError(f"serving submit rejected: {code}")
+            evs.append(ev)
+        for ev in evs:
+            if not ev.wait(300):
+                raise RuntimeError(f"serving A/B stalled ({scheduling})")
+        return n / (time.perf_counter() - t0)
+
+    try:
+        # two warmup rounds of the EXACT timed workload: the queue-depth
+        # profile decides which (batch, context) buckets the decode hits,
+        # so a smaller warmup misses combos (e.g. full batch at long
+        # context) and their compiles would land in the timed run; the
+        # second round covers the donated-pool second jit signature
+        run(n_requests)
+        run(n_requests)
+        return run(n_requests)
+    finally:
+        engine.stop()
+        model.close()
+
+
+def bench_serving_lane():
+    """Serving plane (brpc_tpu/serving/): streamed generations over the
+    RPC path against a pre-warmed child server — aggregate tokens/sec and
+    TTFT percentiles measured at stream-frame arrival — then the
+    in-process continuous-vs-static scheduling A/B on mixed-length
+    traffic. Emits the three serving JSON metric lines."""
+    from brpc_tpu.proto import serving_pb2
+    from brpc_tpu.rpc import Channel, ChannelOptions, Controller, Stub
+    from brpc_tpu.rpc.stream import (StreamOptions, stream_close,
+                                     stream_create)
+
+    threads = 4 if QUICK else 8
+    calls = 3 if QUICK else 8
+    srv = _BenchServer("127.0.0.1:0", "--serving")
+    try:
+        ch = Channel(ChannelOptions(protocol="trpc_std", timeout_ms=120000))
+        ch.init(srv.endpoint)
+        stub = Stub(ch,
+                    serving_pb2.DESCRIPTOR.services_by_name["LlmService"])
+
+        def generate(prompt_len, max_new):
+            t_first = [0.0]
+
+            def on_received(sid, msgs):
+                if not t_first[0]:
+                    t_first[0] = time.perf_counter()
+
+            sid = stream_create(StreamOptions(on_received=on_received))
+            cntl = Controller()
+            cntl.stream_id = sid
+            cntl.timeout_ms = 120000
+            t0 = time.perf_counter()
+            resp = stub.Generate(
+                serving_pb2.GenerateRequest(prompt_len=prompt_len,
+                                            max_new_tokens=max_new),
+                controller=cntl)
+            total = time.perf_counter() - t0
+            stream_close(sid)
+            if cntl.failed():
+                raise RuntimeError(f"Generate failed: {cntl.error_text()}")
+            ttft = (t_first[0] - t0) if t_first[0] else total
+            return len(resp.tokens), ttft
+
+        generate(16, 2)  # warmup: connection + client codepaths
+        tok_count = [0] * threads
+        ttfts = [[] for _ in range(threads)]
+        failures = []
+        barrier = threading.Barrier(threads + 1)
+
+        def worker(idx):
+            barrier.wait()
+            try:
+                for c in range(calls):
+                    n, ttft = generate(16 + 16 * (idx % 2),
+                                       4 if (idx + c) % 2 else 24)
+                    tok_count[idx] += n
+                    ttfts[idx].append(ttft)
+            except BaseException as e:
+                failures.append(e)
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(threads)]
+        for t in ts:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in ts:
+            t.join()
+        wall = time.perf_counter() - t0
+        if failures:
+            raise RuntimeError(f"serving bench worker failed: "
+                               f"{failures[0]!r}") from failures[0]
+        tps = sum(tok_count) / wall
+        lat = sorted(x for l in ttfts for x in l)
+    finally:
+        srv.close()
+
+    n_ab = 16 if QUICK else 32
+    cont_qps = _serving_engine_qps("continuous", n_ab)
+    stat_qps = _serving_engine_qps("static", n_ab)
+    ratio = cont_qps / max(stat_qps, 1e-9)
+    p50 = _percentile(lat, 0.5) * 1e3
+    p99 = _percentile(lat, 0.99) * 1e3
+    print(f"# serving lane: {threads}x{calls} streamed generations "
+          f"tokens/s={tps:,.0f} ttft p50={p50:.1f}ms p99={p99:.1f}ms | "
+          f"A/B {n_ab} mixed-length reqs: continuous={cont_qps:.1f} req/s "
+          f"static={stat_qps:.1f} req/s ratio={ratio:.2f}x "
+          f"({'OK' if ratio >= 1.5 else 'BELOW'} 1.5x floor)",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "serving_tokens_per_sec",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+    }))
+    print(json.dumps({
+        "metric": "serving_ttft_ms",
+        "value": round(p50, 2),
+        "unit": "ms",
+        "p99": round(p99, 2),
+    }))
+    print(json.dumps({
+        "metric": "serving_continuous_vs_static",
+        "value": round(ratio, 3),
+        "unit": "x",
+        "continuous_qps": round(cont_qps, 1),
+        "static_qps": round(stat_qps, 1),
+    }))
+    return ratio
+
+
 def bench_native_lane():
     """The framework's native lane end to end: C++ bench client (the analog
     of the reference's C++ client binaries) against the C++ engine serving
@@ -1079,6 +1240,8 @@ def main() -> None:
         bench_hybrid_native()
     if _phase_enabled("batch"):
         bench_batch_lane()
+    if _phase_enabled("serving"):
+        bench_serving_lane()
     py_1mb = py_64b_qps = series_pct = None
     if _phase_enabled("shm"):
         py_1mb, py_64b_qps = bench_tpu_sweep()
@@ -1116,14 +1279,16 @@ def main() -> None:
         except Exception as e:  # diagnostics must never sink the bench
             print(f"# device probe skipped: {e}", file=sys.stderr)
     # headline: the framework's fastest supported lane (native when built,
-    # like the reference's C++ stack; Python tpu:// sweep otherwise)
-    headline = native_1mb if native_1mb is not None else (py_1mb or 0.0)
-    print(json.dumps({
-        "metric": "echo_1mb_framework_bandwidth",
-        "value": round(headline, 3),
-        "unit": "GB/s",
-        "vs_baseline": round(headline / BASELINE_GBPS, 3),
-    }))
+    # like the reference's C++ stack; Python tpu:// sweep otherwise);
+    # omitted when neither lane ran (e.g. BENCH_PHASES=batch|serving)
+    headline = native_1mb if native_1mb is not None else py_1mb
+    if headline is not None:
+        print(json.dumps({
+            "metric": "echo_1mb_framework_bandwidth",
+            "value": round(headline, 3),
+            "unit": "GB/s",
+            "vs_baseline": round(headline / BASELINE_GBPS, 3),
+        }))
     # small-message summary line: the Python tpu:// sweep's 64B row (the
     # fastpath stack's target metric; vs_baseline is against BENCH_r03)
     if py_64b_qps:

@@ -806,6 +806,77 @@ _CHURN_CTORS = {"Adder", "Maxer", "Miner", "LatencyRecorder", "IntRecorder",
                 "Status", "PassiveStatus"}
 
 
+# --------------------------------------------------------------------------
+# Rule 11: no-per-token-host-sync
+# --------------------------------------------------------------------------
+# The serving engine's throughput contract (PR 13, docs/serving.md): each
+# decode step issues ONE fused device program for the whole batch and
+# host-materializes its tokens exactly once, at the step boundary
+# (model.decode_step's single np.asarray). A host sync inside a
+# per-token/per-sequence loop — .block_until_ready(), .item(),
+# jax.device_get(), np.asarray() on a device value — serializes the
+# device pipeline per token and turns the step's O(1) syncs into
+# O(batch x new_tokens). Scope: brpc_tpu/serving/ wholesale; the sync
+# primitives are fine at function scope (once per call), the rule fires
+# only when one sits lexically inside a for/while loop.
+
+_SYNC_SCOPE_PREFIXES = ("serving/",)
+_SYNC_ATTR_CALLS = {"block_until_ready", "item"}
+_SYNC_NP_RECEIVERS = {"np", "numpy", "onp"}
+
+
+def _host_sync_call(call: ast.Call) -> Optional[str]:
+    """Message when this call forces a device->host sync, else None."""
+    name = attr_chain(call.func)
+    if name is None:
+        return None
+    last = name.split(".")[-1]
+    if last in _SYNC_ATTR_CALLS and not call.args and not call.keywords:
+        return (f"{name}() forces a device->host sync; hoist it out of "
+                f"the loop and materialize the whole batch once")
+    if last == "device_get":
+        return (f"{name}() copies device values to the host per "
+                f"iteration; gather once per step instead")
+    if last == "asarray" and "." in name \
+            and name.split(".")[0] in _SYNC_NP_RECEIVERS:
+        return (f"{name}() on a device value blocks until the result is "
+                f"on the host; batch the transfer outside the loop")
+    return None
+
+
+@register_rule(
+    "no-per-token-host-sync",
+    "serving/ code must not force device->host syncs "
+    "(block_until_ready/.item()/device_get/np.asarray) inside "
+    "per-token or per-sequence loops — one materialization per step")
+def rule_no_per_token_host_sync(pkg: Package) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in pkg.files:
+        if not in_scope(sf.rel, prefixes=_SYNC_SCOPE_PREFIXES):
+            continue
+        seen: Set[Tuple[int, int]] = set()  # nested loops: report once
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            for child in node.body + node.orelse:
+                for sub in ast.walk(child):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        # nested defs don't run per iteration of THIS
+                        # loop; if they sync in their own loops the walk
+                        # visits those separately
+                        break
+                    if isinstance(sub, ast.Call):
+                        msg = _host_sync_call(sub)
+                        key = (sub.lineno, sub.col_offset)
+                        if msg is not None and key not in seen:
+                            seen.add(key)
+                            out.append(Finding(
+                                "no-per-token-host-sync", sf.rel,
+                                sub.lineno, msg))
+    return out
+
+
 @register_rule(
     "metric-churn",
     "no metric construction (Adder/LatencyRecorder/Window/...) or expose() "

@@ -633,6 +633,44 @@ def fault_service(server, http: HttpMessage):
     return 200, CONTENT_JSON, body + "\n"
 
 
+# ------------------------------------------------------------------- serving
+def serving_service(server, http: HttpMessage):
+    """Serving-plane engines: batch occupancy, KV pool watermark, queue
+    depth and step timings. ``?format=json`` for the structured view."""
+    try:
+        from brpc_tpu.serving.engine import active_engines
+    except ImportError:
+        return 200, CONTENT_TEXT, "serving plane not loaded\n"
+    snaps = [e.snapshot() for e in active_engines()]
+    if http.query.get("format", "") == "json":
+        return 200, CONTENT_JSON, json.dumps(
+            {"engines": snaps}, indent=2) + "\n"
+    if not snaps:
+        return 200, CONTENT_TEXT, "no serving engine running\n"
+    out = []
+    for i, s in enumerate(snaps):
+        kv = s["kv"]
+        out.append(f"[engine {i}] scheduling={s['scheduling']} "
+                   f"max_batch={s['max_batch']} "
+                   f"token_budget={s['token_budget']}")
+        out.append(f"  queue_depth={s['queue_depth']} "
+                   f"running={s['running']} steps={s['steps']} "
+                   f"tokens={s['tokens_generated']}")
+        out.append(f"  batch_occupancy_avg={s['batch_occupancy_avg']} "
+                   f"step_us p50={s['step_us_p50']:.0f} "
+                   f"p99={s['step_us_p99']:.0f} "
+                   f"last={s['last_step_us']:.0f}")
+        out.append(f"  ttft_us p50={s['ttft_us_p50']:.0f} "
+                   f"p99={s['ttft_us_p99']:.0f} "
+                   f"itl_us p50={s['itl_us_p50']:.0f}")
+        out.append(f"  kv: {kv['blocks_used']}/{kv['blocks_total']} blocks "
+                   f"used ({kv['used_ratio']:.0%}), "
+                   f"watermark={kv['watermark']:.0%}, "
+                   f"block_size={kv['block_size']}, "
+                   f"sequences={kv['sequences']}")
+    return 200, CONTENT_TEXT, "\n".join(out) + "\n"
+
+
 # -------------------------------------------------------------------- logoff
 def logoff_service(server, http: HttpMessage):
     if server is None:
@@ -673,3 +711,6 @@ register_builtin("fault", fault_service,
 register_builtin("dump", dump_service,
                  "rpc_dump sampler state: counters, per-method histogram, "
                  "dump files")
+register_builtin("serving", serving_service,
+                 "serving engines: batch occupancy, kv watermark, queue "
+                 "depth, step timings (?format=json)")

@@ -254,3 +254,8 @@ def install_default_rules() -> None:
     w.add(WatchRule(
         "shard_worker_death", "g_shard_worker_deaths", KIND_DELTA,
         ">=", 1, window_s=60, for_ticks=1, clear_ticks=10))
+    # serving plane: sustained admission rejects mean the paged KV pool is
+    # pinned above its watermark — clients are being shed EOVERCROWDED
+    w.add(WatchRule(
+        "serving_kv_exhaustion", "g_serving_kv_admission_rejects",
+        KIND_DELTA, ">=", 1, window_s=10, for_ticks=1, clear_ticks=5))

@@ -1,0 +1,32 @@
+"""Continuous-batching inference serving plane.
+
+The device-side pieces of this repo (STREAM-rate HBM staging, flash/ring
+attention, the batch runtime, streams, priority lanes) compose here into
+one LLM-shaped request path, the way bRPC's value was the composed
+Server + batching + streaming + deadline stack rather than any single
+mechanism:
+
+- :mod:`brpc_tpu.serving.kv_cache` — paged KV-cache block manager over
+  DeviceStore HBM handles (fixed-size blocks, per-sequence block tables,
+  refcounts, watermark admission backpressure).
+- :mod:`brpc_tpu.serving.model` — a toy transformer whose weights and KV
+  pools are streamed into HBM by handle; flash-attention prefill and a
+  ring-attention long-context path.
+- :mod:`brpc_tpu.serving.engine` — the iteration-level scheduler: each
+  step is a mixed prefill+decode batch under a token budget, new requests
+  admitted *between* decode steps (continuous batching).
+- :mod:`brpc_tpu.serving.service` — the LlmService RPC surface with
+  per-request token streaming over the Stream API.
+"""
+
+from brpc_tpu.serving.kv_cache import KVCacheConfig, PagedKVCache
+from brpc_tpu.serving.model import ModelConfig, TinyTransformer
+from brpc_tpu.serving.engine import EngineConfig, ServingEngine, active_engines
+from brpc_tpu.serving.service import LlmServingService
+
+__all__ = [
+    "KVCacheConfig", "PagedKVCache",
+    "ModelConfig", "TinyTransformer",
+    "EngineConfig", "ServingEngine", "active_engines",
+    "LlmServingService",
+]

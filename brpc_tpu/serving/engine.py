@@ -1,0 +1,491 @@
+"""Iteration-level scheduler: continuous batching over the paged KV cache.
+
+Orca-style scheduling mapped onto this repo's server: the engine thread
+runs a step loop where each step is a mixed prefill+decode batch under a
+token budget, and new requests are admitted *between* decode steps —
+a long generation never blocks a short one behind it (continuous
+batching). ``scheduling="static"`` keeps the classic gang behavior (admit
+a batch, drain it fully, admit the next) purely as the bench comparison
+lane.
+
+Admission is where policy concentrates, mirroring the server's own
+front door:
+
+- **deadline** — a request whose client budget is already spent
+  (``cntl.deadline_mono``, stamped by server-side deadline enforcement)
+  is rejected with ERPCTIMEDOUT before it ever holds KV blocks; the same
+  re-check the batch runtime does at enqueue.
+- **KV watermark** — :meth:`PagedKVCache.can_admit` keeps decode headroom
+  above the watermark; rejects surface EOVERCROWDED, which the tunnel
+  retry policy already backs off on.
+- **queue depth** — a bounded waiting queue, EOVERCROWDED past the cap.
+
+Each step issues ONE fused device program for the whole decode batch and
+one per prefill (see serving/model.py) — dispatch coalescing at the step
+level. Tokens are host-materialized exactly once per step; per-token
+streaming writes fan out of that single sync (tpulint's
+``no-per-token-host-sync`` rule keeps it that way).
+
+Streaming: a request that arrived with stream settings gets TokenDelta
+frames as steps complete, so TTFT is a stream-arrival time, decoupled
+from the RPC response (which carries the full token list at completion).
+
+Fault points: ``serving.decode.stall`` (injects latency into the step
+loop) and ``serving.kv.exhaust`` (forces admission rejections). A tunnel
+kill mid-generation is detected via the request socket's failed flag;
+in-flight sequences are aborted with EFAILEDSOCKET (retriable) and every
+KV block returns to the pool — ``assert_idle`` audits that, the way the
+CreditLedger audits window teardown.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Deque, Dict, List, Optional
+
+import numpy as np
+
+from brpc_tpu import fault as _fault
+from brpc_tpu.metrics.latency_recorder import LatencyRecorder
+from brpc_tpu.metrics.reducer import Adder
+from brpc_tpu.metrics.status import PassiveStatus
+from brpc_tpu.profiling import registry as _prof
+from brpc_tpu.rpc import errors
+from brpc_tpu.serving.kv_cache import KVCacheFull, PagedKVCache
+from brpc_tpu.serving.model import TinyTransformer
+
+_fault.register("serving.decode.stall",
+                "stall the serving engine's decode step (delay_ms=)")
+_fault.register("serving.kv.exhaust",
+                "force KV-pool admission rejections (EOVERCROWDED)")
+
+g_serving_steps = Adder("g_serving_steps")
+g_serving_tokens = Adder("g_serving_tokens")
+g_serving_prefill_tokens = Adder("g_serving_prefill_tokens")
+g_serving_admitted = Adder("g_serving_admitted")
+g_serving_rejected = Adder("g_serving_rejected")
+g_serving_aborted = Adder("g_serving_aborted")
+g_serving_completed = Adder("g_serving_completed")
+g_serving_deadline_rejects = Adder("g_serving_deadline_rejects")
+g_serving_step = LatencyRecorder().expose("g_serving_step")
+g_serving_ttft = LatencyRecorder().expose("g_serving_ttft")
+g_serving_itl = LatencyRecorder().expose("g_serving_itl")
+
+_engines: List["ServingEngine"] = []
+_engines_lock = threading.Lock()
+
+
+def active_engines() -> List["ServingEngine"]:
+    with _engines_lock:
+        return [e for e in _engines if e.running]
+
+
+def _sum_engines(fn) -> int:
+    return sum(fn(e) for e in active_engines())
+
+
+g_serving_queue_depth = PassiveStatus(
+    lambda: _sum_engines(lambda e: e.queue_depth)) \
+    .expose("g_serving_queue_depth")
+g_serving_queue_depth.prometheus_type = "gauge"
+g_serving_running = PassiveStatus(
+    lambda: _sum_engines(lambda e: e.running_count)) \
+    .expose("g_serving_running")
+g_serving_running.prometheus_type = "gauge"
+
+
+SCHED_CONTINUOUS = "continuous"
+SCHED_STATIC = "static"
+
+
+class EngineConfig:
+    def __init__(self, max_batch: int = 8, token_budget: int = 512,
+                 max_queue: int = 64, max_new_tokens_cap: int = 512,
+                 scheduling: str = SCHED_CONTINUOUS,
+                 idle_wait_s: float = 0.05):
+        if scheduling not in (SCHED_CONTINUOUS, SCHED_STATIC):
+            raise ValueError(f"unknown scheduling {scheduling!r}")
+        self.max_batch = max_batch
+        # per-step budget over prefill tokens + one decode token per
+        # running sequence — the Orca iteration-level knob
+        self.token_budget = token_budget
+        self.max_queue = max_queue
+        self.max_new_tokens_cap = max_new_tokens_cap
+        self.scheduling = scheduling
+        self.idle_wait_s = idle_wait_s
+
+
+STATE_WAITING = "waiting"
+STATE_RUNNING = "running"
+STATE_DONE = "done"
+
+
+class Sequence:
+    """One in-flight generation request."""
+
+    _ids = [0]
+    _ids_lock = threading.Lock()
+
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int,
+                 stop_token: int = 0, cntl=None, done=None,
+                 stream_id: int = 0):
+        with Sequence._ids_lock:
+            Sequence._ids[0] += 1
+            self.seq_id = Sequence._ids[0]
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.stop_token = stop_token
+        self.cntl = cntl
+        self.done = done
+        self.stream_id = stream_id
+        self.state = STATE_WAITING
+        self.out_tokens: List[int] = []
+        self.t_submit = time.monotonic()
+        self.t_first_token = 0.0
+        self.t_last_token = 0.0
+        self.finish_reason = ""
+
+    @property
+    def pos(self) -> int:
+        """0-based position of the NEXT token to append."""
+        return len(self.prompt) + len(self.out_tokens) - 1
+
+    def context_len(self) -> int:
+        return len(self.prompt) + len(self.out_tokens)
+
+
+class ServingEngine:
+    def __init__(self, model: TinyTransformer, kv: Optional[PagedKVCache] = None,
+                 config: Optional[EngineConfig] = None):
+        self.model = model
+        self.kv = kv if kv is not None else model.kv
+        self.config = config or EngineConfig()
+        self._cv = threading.Condition()
+        self._waiting: Deque[Sequence] = collections.deque()
+        self._running: List[Sequence] = []
+        self._thread: Optional[threading.Thread] = None
+        self.running = False
+        self.steps = 0
+        self.tokens_generated = 0
+        self.last_step_us = 0.0
+        self._occupancy_sum = 0
+        with _engines_lock:
+            _engines.append(self)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "ServingEngine":
+        with self._cv:
+            if self.running:
+                return self
+            self.running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="brpc-serving-engine")
+        self._thread.start()
+        return self
+
+    def stop(self, abort_code: int = errors.ELOGOFF) -> None:
+        with self._cv:
+            if not self.running:
+                return
+            self.running = False
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        # fan a retriable error to anything still in flight, then prove
+        # the pool whole — the CreditLedger teardown discipline
+        self._abort_all_locked_out(abort_code, "engine stopped")
+        with _engines_lock:
+            if self in _engines:
+                _engines.remove(self)
+
+    # ------------------------------------------------------------ admission
+    def submit(self, prompt: np.ndarray, max_new_tokens: int,
+               stop_token: int = 0, cntl=None, done=None,
+               stream_id: int = 0) -> "tuple[int, Optional[Sequence]]":
+        """Admission front door (runs on the RPC thread). Returns
+        (error_code, seq): 0 + the queued sequence, or a reject code the
+        caller surfaces through cntl.set_failed."""
+        if max_new_tokens < 1:
+            return errors.EREQUEST, None
+        max_new_tokens = min(max_new_tokens, self.config.max_new_tokens_cap)
+        if len(prompt) < 1 or (len(prompt) + max_new_tokens
+                               > self.model.config.max_context):
+            return errors.EREQUEST, None
+        # deadline at admission (PR 4's server-side enforcement, re-checked
+        # here exactly like the batch runtime re-checks at enqueue)
+        deadline = getattr(cntl, "deadline_mono", 0.0) if cntl else 0.0
+        if deadline and time.monotonic() >= deadline:
+            g_serving_deadline_rejects.put(1)
+            g_serving_rejected.put(1)
+            return errors.ERPCTIMEDOUT, None
+        if _fault.hit("serving.kv.exhaust") is not None:
+            self.kv.note_rejected()
+            g_serving_rejected.put(1)
+            return errors.EOVERCROWDED, None
+        with self._cv:
+            if not self.running:
+                return errors.ELOGOFF, None
+            if len(self._waiting) >= self.config.max_queue:
+                g_serving_rejected.put(1)
+                return errors.EOVERCROWDED, None
+            # watermark backpressure counts queued-but-unadmitted prefill
+            # tokens too, else a burst overcommits the pool before the
+            # step loop catches up
+            queued = sum(s.context_len() for s in self._waiting)
+            if not self.kv.can_admit(queued + len(prompt)):
+                self.kv.note_rejected()
+                g_serving_rejected.put(1)
+                return errors.EOVERCROWDED, None
+            seq = Sequence(prompt, max_new_tokens, stop_token, cntl, done,
+                           stream_id)
+            self._waiting.append(seq)
+            self._cv.notify()
+        return 0, seq
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._waiting)
+
+    @property
+    def running_count(self) -> int:
+        return len(self._running)
+
+    # ------------------------------------------------------------ step loop
+    def _loop(self) -> None:
+        _prof.register_current_thread("serving")
+        try:
+            while True:
+                with self._cv:
+                    while (self.running and not self._waiting
+                           and not self._running):
+                        self._cv.wait(self.config.idle_wait_s)
+                    if not self.running:
+                        return
+                    admitted = self._admit_locked()
+                if not admitted and not self._running:
+                    # waiting work exists but the pool is full — let
+                    # in-flight frees land instead of spinning the step
+                    time.sleep(0.002)
+                    continue
+                try:
+                    self._step(admitted)
+                except Exception as e:  # engine must survive a bad step
+                    for seq in list(self._running):
+                        self._finish(seq, errors.EINTERNAL,
+                                     f"step failed: {e}")
+                    self._running = []
+        finally:
+            _prof.unregister_current_thread()
+
+    def _admit_locked(self) -> List[Sequence]:
+        """Pull waiting sequences into the running set — called between
+        steps with the lock held. Continuous mode refills whenever a slot
+        and budget exist; static mode only when the gang drained."""
+        cfg = self.config
+        if cfg.scheduling == SCHED_STATIC and self._running:
+            return []
+        admitted: List[Sequence] = []
+        budget = cfg.token_budget - len(self._running)
+        while (self._waiting and len(self._running) < cfg.max_batch
+               and budget >= len(self._waiting[0].prompt)):
+            seq = self._waiting[0]
+            deadline = (getattr(seq.cntl, "deadline_mono", 0.0)
+                        if seq.cntl else 0.0)
+            if deadline and time.monotonic() >= deadline:
+                self._waiting.popleft()
+                g_serving_deadline_rejects.put(1)
+                self._finish(seq, errors.ERPCTIMEDOUT,
+                             "deadline expired in serving queue")
+                continue
+            try:
+                self.kv.alloc_sequence(seq.seq_id, seq.context_len())
+            except KVCacheFull:
+                break  # keep FIFO order; retry next step
+            self._waiting.popleft()
+            budget -= len(seq.prompt)
+            seq.state = STATE_RUNNING
+            self._running.append(seq)
+            admitted.append(seq)
+            g_serving_admitted.put(1)
+        return admitted
+
+    def _step(self, admitted: List[Sequence]) -> None:
+        t0 = time.perf_counter_ns()
+        # ---- prefill phase: one fused program per new sequence
+        if admitted:
+            prev = _prof.set_phase("prefill")
+            try:
+                for seq in admitted:
+                    tp0 = time.perf_counter_ns()
+                    table = self.kv.block_table(seq.seq_id)
+                    first = self.model.prefill(seq.prompt, table)
+                    self._append_token(seq, first)
+                    g_serving_prefill_tokens.put(len(seq.prompt))
+                    span = getattr(seq.cntl, "span", None)
+                    if span is not None:
+                        span.add_phase(
+                            "prefill_us",
+                            (time.perf_counter_ns() - tp0) / 1000.0)
+            finally:
+                _prof.set_phase(prev)
+        self._reap_finished()
+        # ---- decode phase: ONE fused program for the whole batch
+        batch = list(self._running)
+        if batch:
+            prev = _prof.set_phase("decode")
+            try:
+                _fault.maybe_sleep(_fault.hit("serving.decode.stall"))
+                td0 = time.perf_counter_ns()
+                tokens = np.array([s.out_tokens[-1] for s in batch],
+                                  dtype=np.int32)
+                # the step's input token (last sampled) is written at the
+                # end of the current context, so capacity must cover
+                # context_len() and the write position is context_len()-1
+                positions = np.array([s.pos for s in batch],
+                                     dtype=np.int32)
+                tables = []
+                for s in batch:
+                    tables.append(self.kv.extend_sequence(
+                        s.seq_id, s.context_len()))
+                nxt = self.model.decode_step(tokens, positions, tables)
+                decode_us = (time.perf_counter_ns() - td0) / 1000.0
+                for s, tok in zip(batch, nxt):
+                    self._append_token(s, int(tok))
+                    span = getattr(s.cntl, "span", None)
+                    if span is not None:
+                        span.add_phase("decode_us",
+                                       decode_us / len(batch))
+            except KVCacheFull:
+                # mid-decode exhaustion: shed the youngest sequences until
+                # the pool has headroom again — admission watermark should
+                # make this rare, never fatal
+                victim = batch[-1]
+                self._finish(victim, errors.EOVERCROWDED,
+                             "kv pool exhausted mid-decode")
+            finally:
+                _prof.set_phase(prev)
+        self._reap_finished()
+        self.steps += 1
+        self._occupancy_sum += len(batch)
+        g_serving_steps.put(1)
+        self.last_step_us = (time.perf_counter_ns() - t0) / 1000.0
+        g_serving_step.record(self.last_step_us)
+
+    # ----------------------------------------------------------- completion
+    def _append_token(self, seq: Sequence, tok: int) -> None:
+        now = time.monotonic()
+        if not seq.out_tokens:
+            seq.t_first_token = now
+            g_serving_ttft.record((now - seq.t_submit) * 1e6)
+        elif seq.t_last_token:
+            g_serving_itl.record((now - seq.t_last_token) * 1e6)
+        seq.t_last_token = now
+        seq.out_tokens.append(tok)
+        self.tokens_generated += 1
+        g_serving_tokens.put(1)
+        finished = (len(seq.out_tokens) >= seq.max_new_tokens
+                    or (seq.stop_token and tok == seq.stop_token))
+        self._stream_delta(seq, [tok], finished)
+        if finished:
+            seq.finish_reason = ("stop_token"
+                                 if seq.stop_token and tok == seq.stop_token
+                                 else "length")
+            seq.state = STATE_DONE
+
+    def _stream_delta(self, seq: Sequence, toks: List[int],
+                      done: bool) -> None:
+        if not seq.stream_id:
+            return
+        from brpc_tpu.proto import serving_pb2
+        from brpc_tpu.rpc.stream import stream_write
+
+        delta = serving_pb2.TokenDelta(
+            seq_id=seq.seq_id, tokens=toks,
+            step=len(seq.out_tokens), done=done)
+        rc = stream_write(seq.stream_id, delta.SerializeToString())
+        if rc != 0:
+            seq.stream_id = 0  # stream died; finish via the RPC response
+
+    def _reap_finished(self) -> None:
+        still: List[Sequence] = []
+        for seq in self._running:
+            sock = getattr(seq.cntl, "_srv_socket", None)
+            if sock is not None and getattr(sock, "failed", False):
+                # tunnel/connection died mid-generation: retriable error
+                # to the sequence, blocks back to the pool
+                self._finish(seq, errors.EFAILEDSOCKET,
+                             "connection failed mid-generation")
+            elif seq.state == STATE_DONE:
+                self._finish(seq, 0, "")
+            else:
+                still.append(seq)
+        self._running = still
+
+    def _finish(self, seq: Sequence, code: int, reason: str) -> None:
+        self.kv.free_sequence(seq.seq_id)
+        if seq.state != STATE_DONE:
+            seq.state = STATE_DONE
+        if code == 0:
+            g_serving_completed.put(1)
+        else:
+            g_serving_aborted.put(1)
+        if seq.stream_id and code != 0:
+            from brpc_tpu.rpc.stream import stream_close
+
+            stream_close(seq.stream_id)
+            seq.stream_id = 0
+        done, seq.done = seq.done, None
+        if done is None:
+            return
+        try:
+            if code != 0 and seq.cntl is not None:
+                seq.cntl.set_failed(code, reason)
+                done(None)
+            else:
+                done(self._response_for(seq))
+        except Exception:
+            pass
+
+    def _response_for(self, seq: Sequence):
+        from brpc_tpu.proto import serving_pb2
+
+        ttft_us = 0
+        if seq.t_first_token:
+            ttft_us = int((seq.t_first_token - seq.t_submit) * 1e6)
+        return serving_pb2.GenerateResponse(
+            tokens=seq.out_tokens, seq_id=seq.seq_id,
+            prompt_len=len(seq.prompt), steps=len(seq.out_tokens),
+            ttft_us=ttft_us, finish_reason=seq.finish_reason or "length")
+
+    def _abort_all_locked_out(self, code: int, reason: str) -> None:
+        with self._cv:
+            pending = list(self._waiting) + list(self._running)
+            self._waiting.clear()
+            self._running = []
+        for seq in pending:
+            self._finish(seq, code, reason)
+
+    # ------------------------------------------------------------ visibility
+    def snapshot(self) -> Dict[str, object]:
+        kv = self.kv.snapshot()
+        occ = (self._occupancy_sum / self.steps) if self.steps else 0.0
+        return {
+            "scheduling": self.config.scheduling,
+            "max_batch": self.config.max_batch,
+            "token_budget": self.config.token_budget,
+            "queue_depth": self.queue_depth,
+            "running": self.running_count,
+            "steps": self.steps,
+            "tokens_generated": self.tokens_generated,
+            "batch_occupancy_avg": round(occ, 3),
+            "last_step_us": round(self.last_step_us, 1),
+            "step_us_p50": g_serving_step.latency_percentile(0.5),
+            "step_us_p99": g_serving_step.latency_percentile(0.99),
+            "ttft_us_p50": g_serving_ttft.latency_percentile(0.5),
+            "ttft_us_p99": g_serving_ttft.latency_percentile(0.99),
+            "itl_us_p50": g_serving_itl.latency_percentile(0.5),
+            "kv": kv,
+        }

@@ -1,0 +1,319 @@
+"""Paged KV cache over DeviceStore HBM handles.
+
+vLLM-style block-paged KV management mapped onto this repo's device lane:
+the K and V pools are single device arrays of ``num_blocks`` fixed-size
+blocks, registered in a :class:`~brpc_tpu.tpu.device_lane.DeviceStore`
+under stable handles (``adopt``/``replace``), so pool residency is visible
+to /vars and DeviceStats like any other staged payload. Sequences own
+*block tables* — host-side lists of physical block ids — that grow on
+demand as decode appends tokens; allocation and free are refcounted so a
+forked prefix can share blocks.
+
+Admission backpressure is watermark-based: a new sequence is admitted only
+while the pool (after its prefill blocks) stays under ``watermark`` of
+capacity. The slack above the watermark is decode headroom — blocks that
+*running* sequences may still grow into — so admission rejections
+(surfaced as EOVERCROWDED, which the tunnel retry policy already treats as
+retriable) come before mid-generation exhaustion, not instead of it.
+
+Physical block 0 is a scratch block: padded lanes of the fused
+prefill/decode programs scatter there, so it is never handed out and never
+counted in capacity.
+
+Under ``BRPC_TPU_CHECK=1`` every alloc/free re-audits the invariants
+(free + used = capacity, refcounts consistent with tables), and
+:meth:`PagedKVCache.assert_idle` gives teardown the same discipline the
+CreditLedger gives tunnel windows: a chaos-killed generation must return
+every block before the engine reports the pool whole.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+from brpc_tpu.metrics.reducer import Adder
+from brpc_tpu.metrics.status import PassiveStatus
+
+g_serving_kv_block_allocs = Adder("g_serving_kv_block_allocs")
+g_serving_kv_block_frees = Adder("g_serving_kv_block_frees")
+g_serving_kv_admission_rejects = Adder("g_serving_kv_admission_rejects")
+
+_caches: "weakref.WeakSet[PagedKVCache]" = weakref.WeakSet()
+
+
+def _sum_caches(attr) -> int:
+    return sum(attr(c) for c in list(_caches))
+
+
+g_serving_kv_blocks_total = PassiveStatus(
+    lambda: _sum_caches(lambda c: c.num_blocks)) \
+    .expose("g_serving_kv_blocks_total")
+g_serving_kv_blocks_total.prometheus_type = "gauge"
+g_serving_kv_blocks_used = PassiveStatus(
+    lambda: _sum_caches(lambda c: c.used_blocks)) \
+    .expose("g_serving_kv_blocks_used")
+g_serving_kv_blocks_used.prometheus_type = "gauge"
+
+
+class KVCacheFull(Exception):
+    """Raised when the pool cannot satisfy an allocation (maps to
+    EOVERCROWDED at the RPC surface)."""
+
+
+class KVCacheConfig:
+    def __init__(self, block_size: int = 16, num_blocks: int = 128,
+                 watermark: float = 0.90):
+        if block_size < 1 or num_blocks < 1:
+            raise ValueError("block_size/num_blocks must be >= 1")
+        if not 0.0 < watermark <= 1.0:
+            raise ValueError("watermark must be in (0, 1]")
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.watermark = watermark
+
+
+class PagedKVCache:
+    """Block manager + the device-resident K/V pools behind it."""
+
+    def __init__(self, config: KVCacheConfig, layers: int, kv_dim: int,
+                 store=None, dtype=None):
+        import jax.numpy as jnp
+
+        from brpc_tpu.tpu.device_lane import global_store
+
+        self.config = config
+        self.layers = layers
+        self.kv_dim = kv_dim
+        self.store = store if store is not None else global_store()
+        self._lock = threading.Lock()
+        # physical block 0 is scratch (pad scatter target): +1 below
+        slots = (config.num_blocks + 1) * config.block_size
+        dtype = dtype or jnp.float32
+        self.k_pool = jnp.zeros((layers, slots, kv_dim), dtype=dtype)
+        self.v_pool = jnp.zeros((layers, slots, kv_dim), dtype=dtype)
+        self.k_handle, _ = self.store.adopt(self.k_pool)
+        self.v_handle, _ = self.store.adopt(self.v_pool)
+        self._free: List[int] = list(range(config.num_blocks, 0, -1))
+        self._ref: Dict[int, int] = {}
+        self._tables: Dict[int, List[int]] = {}
+        self._seq_len: Dict[int, int] = {}
+        self._check = False
+        try:
+            from brpc_tpu.analysis import runtime_check
+            self._check = bool(runtime_check.ACTIVE)
+        except Exception:
+            pass
+        _caches.add(self)
+
+    # ------------------------------------------------------------- geometry
+    @property
+    def num_blocks(self) -> int:
+        return self.config.num_blocks
+
+    @property
+    def block_size(self) -> int:
+        return self.config.block_size
+
+    @property
+    def used_blocks(self) -> int:
+        with self._lock:
+            return self.config.num_blocks - len(self._free)
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def used_ratio(self) -> float:
+        return self.used_blocks / float(self.config.num_blocks)
+
+    def blocks_for(self, ntokens: int) -> int:
+        bs = self.config.block_size
+        return max(1, (ntokens + bs - 1) // bs)
+
+    # ------------------------------------------------------------ admission
+    def can_admit(self, ntokens: int) -> bool:
+        """Watermark admission: the pool after this sequence's prefill
+        blocks must stay at or under ``watermark`` of capacity, leaving
+        the slack as decode headroom for sequences already running."""
+        need = self.blocks_for(ntokens)
+        limit = int(self.config.watermark * self.config.num_blocks)
+        with self._lock:
+            used = self.config.num_blocks - len(self._free)
+            return used + need <= limit
+
+    def note_rejected(self) -> None:
+        g_serving_kv_admission_rejects.put(1)
+
+    # ----------------------------------------------------------- block ops
+    def _take_block_locked(self) -> int:
+        if not self._free:
+            raise KVCacheFull(
+                f"kv pool exhausted ({self.config.num_blocks} blocks)")
+        b = self._free.pop()
+        self._ref[b] = 1
+        return b
+
+    def alloc_sequence(self, seq_id: int, ntokens: int) -> List[int]:
+        """Allocate blocks covering an ``ntokens``-long prefix; returns the
+        block table (physical ids, in position order)."""
+        need = self.blocks_for(ntokens)
+        with self._lock:
+            if seq_id in self._tables:
+                raise ValueError(f"sequence {seq_id} already has a table")
+            if len(self._free) < need:
+                g_serving_kv_admission_rejects.put(1)
+                raise KVCacheFull(
+                    f"need {need} blocks, {len(self._free)} free")
+            table = [self._take_block_locked() for _ in range(need)]
+            self._tables[seq_id] = table
+            self._seq_len[seq_id] = ntokens
+            self._audit_locked()
+        g_serving_kv_block_allocs.put(need)
+        return list(table)
+
+    def extend_sequence(self, seq_id: int, new_len: int) -> List[int]:
+        """Grow a block table so it covers ``new_len`` tokens (decode
+        append). Shared blocks stay shared — only fresh tail blocks are
+        allocated."""
+        with self._lock:
+            table = self._tables.get(seq_id)
+            if table is None:
+                raise KeyError(f"unknown sequence {seq_id}")
+            need = self.blocks_for(new_len)
+            grew = 0
+            while len(table) < need:
+                table.append(self._take_block_locked())
+                grew += 1
+            self._seq_len[seq_id] = new_len
+            self._audit_locked()
+        if grew:
+            g_serving_kv_block_allocs.put(grew)
+        return list(table)
+
+    def fork_sequence(self, src_seq: int, dst_seq: int) -> List[int]:
+        """Share ``src``'s blocks with a new sequence (refcount++); the
+        caller copies the partial tail block device-side before either
+        sequence appends."""
+        with self._lock:
+            table = self._tables.get(src_seq)
+            if table is None:
+                raise KeyError(f"unknown sequence {src_seq}")
+            if dst_seq in self._tables:
+                raise ValueError(f"sequence {dst_seq} already has a table")
+            for b in table:
+                self._ref[b] += 1
+            self._tables[dst_seq] = list(table)
+            self._seq_len[dst_seq] = self._seq_len[src_seq]
+            self._audit_locked()
+        return list(self._tables[dst_seq])
+
+    def free_sequence(self, seq_id: int) -> int:
+        """Drop a sequence's table; blocks return to the free list when
+        their refcount hits zero. Returns blocks actually freed."""
+        freed = 0
+        with self._lock:
+            table = self._tables.pop(seq_id, None)
+            self._seq_len.pop(seq_id, None)
+            if table is None:
+                return 0
+            for b in table:
+                self._ref[b] -= 1
+                if self._ref[b] == 0:
+                    del self._ref[b]
+                    self._free.append(b)
+                    freed += 1
+            self._audit_locked()
+        if freed:
+            g_serving_kv_block_frees.put(freed)
+        return freed
+
+    def block_table(self, seq_id: int) -> Optional[List[int]]:
+        with self._lock:
+            t = self._tables.get(seq_id)
+            return list(t) if t is not None else None
+
+    def seq_len(self, seq_id: int) -> int:
+        with self._lock:
+            return self._seq_len.get(seq_id, 0)
+
+    def live_sequences(self) -> List[int]:
+        with self._lock:
+            return sorted(self._tables)
+
+    # ------------------------------------------------------------ pool swap
+    def update_pools(self, k_pool, v_pool) -> None:
+        """Install the post-step pool arrays (functional update output) and
+        re-point the DeviceStore handles at them — one swap per engine
+        step, not per token."""
+        self.k_pool = k_pool
+        self.v_pool = v_pool
+        self.store.replace(self.k_handle, k_pool)
+        self.store.replace(self.v_handle, v_pool)
+
+    # ---------------------------------------------------------------- audit
+    def _audit_locked(self) -> None:
+        if not self._check:
+            return
+        problems = self._invariant_problems_locked()
+        if problems:
+            raise AssertionError("kv ledger violation: " +
+                                 "; ".join(problems))
+
+    def _invariant_problems_locked(self) -> List[str]:
+        problems: List[str] = []
+        held: Dict[int, int] = {}
+        for seq, table in self._tables.items():
+            for b in table:
+                held[b] = held.get(b, 0) + 1
+        if held != self._ref:
+            problems.append(
+                f"refcounts {self._ref} disagree with tables {held}")
+        in_free = set(self._free)
+        if len(in_free) != len(self._free):
+            problems.append("duplicate block on the free list")
+        overlap = in_free & set(held)
+        if overlap:
+            problems.append(f"blocks {sorted(overlap)} both free and held")
+        if len(self._free) + len(self._ref) != self.config.num_blocks:
+            problems.append(
+                f"{len(self._free)} free + {len(self._ref)} held != "
+                f"{self.config.num_blocks} capacity")
+        return problems
+
+    def assert_idle(self, context: str = "") -> None:
+        """Teardown wholeness check, mirroring CreditLedger.assert_balanced:
+        every block must be back on the free list with no refs held."""
+        with self._lock:
+            problems = self._invariant_problems_locked()
+            if self._tables:
+                problems.append(
+                    f"{len(self._tables)} sequence table(s) still live: "
+                    f"{sorted(self._tables)}")
+            if len(self._free) != self.config.num_blocks:
+                problems.append(
+                    f"{self.config.num_blocks - len(self._free)} "
+                    f"block(s) leaked")
+        if problems:
+            where = f" [{context}]" if context else ""
+            raise AssertionError(f"kv pool not idle{where}: " +
+                                 "; ".join(problems))
+
+    def close(self) -> None:
+        self.store.free(self.k_handle)
+        self.store.free(self.v_handle)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            used = self.config.num_blocks - len(self._free)
+            return {
+                "block_size": self.config.block_size,
+                "blocks_total": self.config.num_blocks,
+                "blocks_used": used,
+                "blocks_free": len(self._free),
+                "watermark": self.config.watermark,
+                "used_ratio": used / float(self.config.num_blocks),
+                "sequences": len(self._tables),
+            }

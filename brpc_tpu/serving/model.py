@@ -1,0 +1,362 @@
+"""Toy sharded transformer for the serving plane.
+
+Small enough to decode on the CPU test substrate, shaped enough that every
+device-side mechanism in the repo carries weight on the request path:
+
+- **Weights by handle** — parameters are packed into one flat buffer and
+  staged into HBM through ``DeviceStore.put`` (the device lane's single
+  host→device crossing); compute looks them up by handle and unpacks
+  device-side, so the serving plane owns no host-resident copy.
+- **Paged KV** — prefill scatters K/V into the :class:`PagedKVCache`
+  pools at block-table slots; decode gathers context pages and appends
+  the new token's K/V, all inside ONE jitted program per engine step
+  (donated pools → in-place updates, one dispatch for the whole mixed
+  batch — the op-coalescing trick the device lane's dispatch thread plays,
+  applied to the decode path).
+- **Flash-attention prefill** — prompt self-attention runs the Pallas
+  flash kernel from ``tpu/pallas_ops.py`` (interpret-mode on CPU), with
+  the O(S²) reference as the numerics oracle; long prompts route through
+  the ring-attention path (``tpu/ring.py``) which shard_maps across the
+  ``sp`` mesh axis.
+- **jax-0.4.37 shims** — shard_map comes through the same version-guarded
+  import ``tpu/collective.py`` uses; sharded placement goes through
+  ``tpu/mesh.named_sharding`` (jit follows input shardings — the pjit
+  lowering on this jax line).
+
+Shapes are bucketed (batch to powers of two, sequence to block-size
+multiples) so the jit cache stays bounded across traffic mixes.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from brpc_tpu.serving.kv_cache import PagedKVCache
+
+
+class ModelConfig:
+    def __init__(self, vocab: int = 512, d_model: int = 64,
+                 n_heads: int = 4, n_layers: int = 2,
+                 max_context: int = 1024, seed: int = 0,
+                 attn: str = "auto", ring_threshold: int = 4096):
+        if d_model % n_heads:
+            raise ValueError("d_model must divide n_heads")
+        self.vocab = vocab
+        self.d_model = d_model
+        self.n_heads = n_heads
+        self.n_layers = n_layers
+        self.max_context = max_context
+        self.seed = seed
+        # "auto": flash kernel on TPU, reference einsum on the CPU
+        # substrate (interpret-mode Pallas is correct but slow); tests pin
+        # "flash" to exercise the kernel path end to end.
+        self.attn = attn
+        self.ring_threshold = ring_threshold
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def kv_dim(self) -> int:
+        return self.d_model
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class TinyTransformer:
+    """Weights + the fused prefill/decode programs over a PagedKVCache."""
+
+    def __init__(self, config: ModelConfig, kv: PagedKVCache,
+                 store=None, mesh=None):
+        import jax
+
+        from brpc_tpu.tpu.device_lane import global_store
+
+        self.config = config
+        self.kv = kv
+        self.store = store if store is not None else kv.store
+        self.mesh = mesh
+        self._lock = threading.Lock()
+        self._prefill_cache = {}
+        self._decode_cache = {}
+        self._on_tpu = jax.default_backend() == "tpu"
+
+        # ---- weights: pack host-side once, stream into HBM by handle
+        flat, self._offsets = self._init_weights(config)
+        self.param_handle, self.param_nbytes = self.store.put(
+            flat.tobytes())
+        params_u8 = self.store.lookup(self.param_handle)
+        self._params = self._unpack_params(params_u8)
+        if mesh is not None:
+            # replicate params across the mesh; jit follows the placement
+            from brpc_tpu.tpu.mesh import named_sharding
+
+            self._params = jax.device_put(
+                self._params, named_sharding(mesh))
+
+    # ------------------------------------------------------------- weights
+    def _init_weights(self, cfg: ModelConfig):
+        rng = np.random.RandomState(cfg.seed)
+        d, v = cfg.d_model, cfg.vocab
+        shapes = [("embed", (v, d))]
+        for l in range(cfg.n_layers):
+            shapes += [(f"wqkv{l}", (d, 3 * d)), (f"wo{l}", (d, d)),
+                       (f"w1{l}", (d, 2 * d)), (f"w2{l}", (2 * d, d))]
+        offsets = []
+        pos = 0
+        parts = []
+        for name, shape in shapes:
+            n = int(np.prod(shape))
+            offsets.append((name, pos, shape))
+            parts.append((rng.standard_normal(n) *
+                          (0.5 / np.sqrt(shape[0]))).astype(np.float32))
+            pos += n
+        return np.concatenate(parts), offsets
+
+    def _unpack_params(self, params_u8):
+        """Device-side: reinterpret the staged byte buffer as the weight
+        pytree (one bitcast + views, no host copy)."""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def unpack(u8):
+            f32 = jax.lax.bitcast_convert_type(
+                u8.reshape(-1, 4), jnp.float32).reshape(-1)
+            return {name: f32[pos:pos + int(np.prod(shape))].reshape(shape)
+                    for name, pos, shape in self._offsets}
+
+        return jax.tree_util.tree_map(lambda x: x, unpack(params_u8))
+
+    # ----------------------------------------------------------- attention
+    def _use_flash(self) -> bool:
+        if self.config.attn == "flash":
+            return True
+        if self.config.attn == "reference":
+            return False
+        return self._on_tpu
+
+    # ------------------------------------------------------------- prefill
+    def _prefill_fn(self, s_bucket: int, use_flash: bool):
+        import jax
+        import jax.numpy as jnp
+
+        from brpc_tpu.tpu import pallas_ops
+
+        cfg = self.config
+        H, hd = cfg.n_heads, cfg.head_dim
+
+        def rms(x):
+            return x * jax.lax.rsqrt(
+                jnp.mean(jnp.square(x), axis=-1, keepdims=True) + 1e-6)
+
+        def impl(params, kpool, vpool, tokens, slots, length):
+            x = params["embed"][tokens]                      # (S, D)
+            for l in range(cfg.n_layers):
+                h = rms(x)
+                qkv = h @ params[f"wqkv{l}"]
+                q, k, vv = jnp.split(qkv, 3, axis=-1)
+                kpool = kpool.at[l, slots].set(k)
+                vpool = vpool.at[l, slots].set(vv)
+                qh = q.reshape(s_bucket, H, hd)
+                kh = k.reshape(s_bucket, H, hd)
+                vh = vv.reshape(s_bucket, H, hd)
+                if use_flash:
+                    attn = jax.vmap(
+                        functools.partial(pallas_ops.flash_attention,
+                                          causal=True),
+                        in_axes=1, out_axes=1)(qh, kh, vh)
+                else:
+                    attn = jax.vmap(
+                        functools.partial(pallas_ops.attention_reference,
+                                          causal=True),
+                        in_axes=1, out_axes=1)(qh, kh, vh)
+                x = x + attn.reshape(s_bucket, -1) @ params[f"wo{l}"]
+                h2 = rms(x)
+                x = x + jax.nn.relu(h2 @ params[f"w1{l}"]) @ params[f"w2{l}"]
+            last = rms(x[length - 1])
+            logits = last @ params["embed"].T
+            return kpool, vpool, jnp.argmax(logits).astype(jnp.int32)
+
+        return jax.jit(impl, donate_argnums=(1, 2))
+
+    def _slots_for(self, table: Sequence[int], upto: int,
+                   pad_to: int) -> np.ndarray:
+        """Flat pool slot per token position (host-side); padded positions
+        point at scratch block 0."""
+        bs = self.kv.block_size
+        t = np.arange(pad_to, dtype=np.int32)
+        tab = np.asarray(table, dtype=np.int32)
+        blocks = np.where(t < upto, tab[np.minimum(t // bs,
+                                                   len(tab) - 1)], 0)
+        live = (t < upto).astype(np.int32)
+        return (blocks * bs + (t % bs)) * live
+
+    def prefill(self, tokens: np.ndarray, table: Sequence[int]) -> int:
+        """Run prompt prefill for ONE sequence: scatter its K/V pages into
+        the pool and return the first generated token (greedy). Long
+        prompts take the ring-attention path."""
+        cfg = self.config
+        s = len(tokens)
+        if s >= cfg.ring_threshold:
+            return self._prefill_ring(tokens, table)
+        bucket = max(16, _next_pow2(s))
+        if bucket > 128:
+            bucket = ((s + 127) // 128) * 128  # flash wants S % 128 == 0
+        use_flash = self._use_flash()
+        key = (bucket, use_flash)
+        with self._lock:
+            fn = self._prefill_cache.get(key)
+            if fn is None:
+                fn = self._prefill_fn(bucket, use_flash)
+                self._prefill_cache[key] = fn
+        toks = np.zeros(bucket, dtype=np.int32)
+        toks[:s] = tokens
+        slots = self._slots_for(table, s, bucket)
+        kpool, vpool, nxt = fn(self._params, self.kv.k_pool,
+                               self.kv.v_pool, toks, slots, s)
+        self.kv.update_pools(kpool, vpool)
+        return int(nxt)
+
+    def _prefill_ring(self, tokens: np.ndarray,
+                      table: Sequence[int]) -> int:
+        """Long-context prefill: per-layer attention through the ring
+        (sequence-sharded shard_map over the ``sp`` axis; single-device
+        meshes degenerate to one hop). Layer loop runs host-side — prompts
+        this long are rare and the per-layer ring call is itself fused."""
+        import jax
+        import jax.numpy as jnp
+
+        from brpc_tpu.tpu import ring
+        from brpc_tpu.tpu.mesh import default_mesh
+
+        cfg = self.config
+        H, hd = cfg.n_heads, cfg.head_dim
+        mesh = self.mesh if (self.mesh is not None
+                             and "sp" in self.mesh.axis_names) \
+            else default_mesh("sp")
+        n = mesh.shape["sp"]
+        s = len(tokens)
+        pad = ((s + n - 1) // n) * n
+        p = self._params
+
+        def rms(x):
+            return x * jax.lax.rsqrt(
+                jnp.mean(jnp.square(x), axis=-1, keepdims=True) + 1e-6)
+
+        toks = np.zeros(pad, dtype=np.int32)
+        toks[:s] = tokens
+        x = p["embed"][jnp.asarray(toks)]
+        kpool, vpool = self.kv.k_pool, self.kv.v_pool
+        slots = jnp.asarray(self._slots_for(table, s, pad))
+        for l in range(cfg.n_layers):
+            h = rms(x)
+            qkv = h @ p[f"wqkv{l}"]
+            q, k, vv = jnp.split(qkv, 3, axis=-1)
+            kpool = kpool.at[l, slots].set(k)
+            vpool = vpool.at[l, slots].set(vv)
+            qh = q.reshape(1, pad, H, hd)
+            kh = k.reshape(1, pad, H, hd)
+            vh = vv.reshape(1, pad, H, hd)
+            attn = ring.ring_attention(qh, kh, vh, mesh, "sp", causal=True)
+            x = x + attn.reshape(pad, -1) @ p[f"wo{l}"]
+            h2 = rms(x)
+            x = x + jax.nn.relu(h2 @ p[f"w1{l}"]) @ p[f"w2{l}"]
+        self.kv.update_pools(kpool, vpool)
+        logits = rms(x[s - 1]) @ p["embed"].T
+        return int(jnp.argmax(logits))
+
+    # -------------------------------------------------------------- decode
+    def _decode_fn(self, b_bucket: int, l_bucket: int):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        H, hd = cfg.n_heads, cfg.head_dim
+
+        def rms(x):
+            return x * jax.lax.rsqrt(
+                jnp.mean(jnp.square(x), axis=-1, keepdims=True) + 1e-6)
+
+        def impl(params, kpool, vpool, tokens, positions, slot_tables):
+            # tokens (B,), positions (B,), slot_tables (B, Lmax): flat
+            # pool slot for every context position (pads → scratch blk 0)
+            B, L = b_bucket, l_bucket
+            x = params["embed"][tokens]                       # (B, D)
+            write = slot_tables[jnp.arange(B), positions]     # (B,)
+            mask = (jnp.arange(L)[None, :]
+                    <= positions[:, None])                    # (B, L)
+            for l in range(cfg.n_layers):
+                h = rms(x)
+                qkv = h @ params[f"wqkv{l}"]
+                q, k, vv = jnp.split(qkv, 3, axis=-1)
+                kpool = kpool.at[l, write].set(k)
+                vpool = vpool.at[l, write].set(vv)
+                ks = kpool[l][slot_tables]                    # (B, L, D)
+                vs = vpool[l][slot_tables]
+                qh = q.reshape(B, H, hd)
+                kh = ks.reshape(B, L, H, hd)
+                vh = vs.reshape(B, L, H, hd)
+                s = jnp.einsum("bhd,blhd->bhl", qh, kh) / np.sqrt(hd)
+                s = jnp.where(mask[:, None, :], s, -1e30)
+                patt = jax.nn.softmax(s, axis=-1)
+                attn = jnp.einsum("bhl,blhd->bhd", patt, vh)
+                x = x + attn.reshape(B, -1) @ params[f"wo{l}"]
+                h2 = rms(x)
+                x = x + jax.nn.relu(h2 @ params[f"w1{l}"]) @ params[f"w2{l}"]
+            logits = rms(x) @ params["embed"].T               # (B, V)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return kpool, vpool, nxt
+
+        return jax.jit(impl, donate_argnums=(1, 2))
+
+    def decode_step(self, tokens: np.ndarray, positions: np.ndarray,
+                    tables: List[Sequence[int]]) -> np.ndarray:
+        """ONE fused device dispatch for the whole decode batch: append
+        each sequence's token at its position, gather paged context, and
+        return the next token per sequence (host-materialized once, here,
+        not per token)."""
+        bs = self.kv.block_size
+        B = len(tokens)
+        b_bucket = max(2, _next_pow2(B))
+        max_blocks = max(len(t) for t in tables)
+        l_bucket = max(2, _next_pow2(max_blocks)) * bs
+        key = (b_bucket, l_bucket)
+        with self._lock:
+            fn = self._decode_cache.get(key)
+            if fn is None:
+                fn = self._decode_fn(b_bucket, l_bucket)
+                self._decode_cache[key] = fn
+        toks = np.zeros(b_bucket, dtype=np.int32)
+        toks[:B] = tokens
+        pos = np.zeros(b_bucket, dtype=np.int32)
+        pos[:B] = positions
+        slot_tables = np.zeros((b_bucket, l_bucket), dtype=np.int32)
+        for i, table in enumerate(tables):
+            slot_tables[i] = self._slots_for(table, positions[i] + 1,
+                                             l_bucket)
+        kpool, vpool, nxt = fn(self._params, self.kv.k_pool,
+                               self.kv.v_pool, toks, pos, slot_tables)
+        self.kv.update_pools(kpool, vpool)
+        return np.asarray(nxt[:B])
+
+    # ------------------------------------------------------------- helpers
+    def close(self) -> None:
+        self.store.free(self.param_handle)
+
+    def synth_prompt(self, length: int) -> np.ndarray:
+        """Deterministic prompt for bench/replay traffic (keyed only by
+        length so a dumped corpus replays bit-identically)."""
+        v = self.config.vocab
+        return ((np.arange(length, dtype=np.int64) * 31 + 7)
+                % (v - 1)).astype(np.int32) + 1

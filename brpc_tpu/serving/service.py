@@ -1,0 +1,66 @@
+"""LlmService — the serving plane's RPC surface.
+
+``Generate`` is an async method in the server's dispatch contract: the
+handler returns None without calling ``done`` and the engine completes the
+RPC from its step loop when generation finishes (or is rejected/aborted).
+A request that arrives with stream settings (client created a stream and
+set ``cntl.stream_id``) is accepted before admission; TokenDelta frames
+then flow per step, so the client's first token arrives while the RPC is
+still in flight — TTFT < full-generation latency by construction.
+
+Requests carrying stream settings take the server's full dispatch path
+(the slim/fast lanes only accept requests without them), which is also
+what stamps ``cntl.deadline_mono`` for the engine's admission re-check and
+carries the span the engine annotates with prefill/decode phases.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from brpc_tpu.proto import serving_pb2
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.server import Service
+from brpc_tpu.rpc.stream import StreamOptions, stream_accept
+from brpc_tpu.serving.engine import ServingEngine
+
+
+class LlmServingService(Service):
+    DESCRIPTOR = serving_pb2.DESCRIPTOR.services_by_name["LlmService"]
+
+    def __init__(self, engine: ServingEngine):
+        super().__init__()
+        self.engine = engine
+
+    def Generate(self, cntl, request, done):
+        if request.prompt_tokens:
+            prompt = np.asarray(request.prompt_tokens, dtype=np.int32)
+        elif request.prompt_len > 0:
+            prompt = self.engine.model.synth_prompt(request.prompt_len)
+        else:
+            cntl.set_failed(errors.EREQUEST,
+                            "need prompt_tokens or prompt_len")
+            return serving_pb2.GenerateResponse()
+        stream_id = 0
+        meta = getattr(cntl, "_srv_meta", None)
+        if meta is not None and meta.stream_settings.stream_id:
+            stream_id = stream_accept(cntl, StreamOptions())
+        code, _seq = self.engine.submit(
+            prompt, request.max_new_tokens or 16,
+            stop_token=request.stop_token, cntl=cntl, done=done,
+            stream_id=stream_id)
+        if code != 0:
+            cntl.set_failed(code, "serving admission rejected")
+            return serving_pb2.GenerateResponse()
+        return None  # async: the engine's step loop calls done()
+
+    def Stats(self, cntl, request, done):
+        e = self.engine
+        kv = e.kv.snapshot()
+        return serving_pb2.ServingStats(
+            seqs_running=e.running_count, seqs_waiting=e.queue_depth,
+            kv_blocks_total=kv["blocks_total"],
+            kv_blocks_used=kv["blocks_used"],
+            steps=e.steps, tokens_generated=e.tokens_generated)

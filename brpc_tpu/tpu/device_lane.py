@@ -188,6 +188,34 @@ class DeviceStore:
         with self._lock:
             return self._arrays.get(handle)
 
+    def adopt(self, arr) -> Tuple[int, int]:
+        """Register an already-device-resident array under a fresh handle
+        (no host crossing). The serving plane parks its paged KV pools here
+        so pool residency shows up in /vars and Stats next to staged
+        payloads."""
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._arrays[h] = arr
+            self._resident_bytes += arr.nbytes
+        g_device_resident_bytes.put(arr.nbytes)
+        return h, arr.nbytes
+
+    def replace(self, handle: int, arr) -> bool:
+        """Swap the array behind a live handle. Functional updates (jit
+        with donated buffers) produce a NEW array each step; the handle
+        stays the stable name for the pool across steps."""
+        with self._lock:
+            old = self._arrays.get(handle)
+            if old is None:
+                return False
+            self._arrays[handle] = arr
+            delta = arr.nbytes - old.nbytes
+            self._resident_bytes += delta
+        if delta:
+            g_device_resident_bytes.put(delta)
+        return True
+
     def free(self, handle: int) -> bool:
         with self._lock:
             arr = self._arrays.pop(handle, None)

@@ -41,7 +41,10 @@ KIND_SERVER = "server"
 # only these roll up into the process-wide g_span_phase_* aggregates so a
 # buggy caller can't mint unbounded /vars.
 PHASE_NAMES = ("queue_us", "parse_us", "credit_wait_us", "send_us",
-               "batch_wait_us", "execute_us", "respond_us")
+               "batch_wait_us", "execute_us", "respond_us",
+               # serving plane: prompt prefill and the request's share of
+               # each fused decode step, stamped by the engine's step loop
+               "prefill_us", "decode_us")
 
 # Hard cap on structured events per span: a 16MB streaming send emits one
 # event per pipeline quantum, which is bounded, but a pathological retry

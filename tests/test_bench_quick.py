@@ -149,6 +149,61 @@ def test_batch_phase_skips_others(batch_bench_run):
     assert "# device lane" not in err
 
 
+@pytest.fixture(scope="module")
+def serving_bench_run():
+    env = dict(os.environ,
+               BENCH_QUICK="1",
+               BENCH_PHASES="serving",
+               BENCH_SKIP_DEVICE="1",
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                          capture_output=True, text=True, timeout=420,
+                          cwd=REPO, env=env)
+    assert proc.returncode == 0, \
+        f"bench.py failed rc={proc.returncode}:\n{proc.stderr[-2000:]}"
+    return proc
+
+
+def test_serving_lane_json_metrics(serving_bench_run):
+    """The serving phase emits exactly its three machine-readable lines:
+    streamed tokens/sec, TTFT percentiles measured at stream-frame
+    arrival, and the continuous-vs-static scheduling ratio."""
+    rows = [json.loads(l) for l in serving_bench_run.stdout.splitlines()
+            if l.startswith("{")]
+    by = {r["metric"]: r for r in rows}
+    assert set(by) == {"serving_tokens_per_sec", "serving_ttft_ms",
+                       "serving_continuous_vs_static"}, \
+        serving_bench_run.stdout
+    assert by["serving_tokens_per_sec"]["unit"] == "tokens/s"
+    assert by["serving_tokens_per_sec"]["value"] > 0
+    ttft = by["serving_ttft_ms"]
+    assert ttft["unit"] == "ms" and ttft["value"] > 0
+    assert ttft["p99"] >= ttft["value"], ttft
+
+
+def test_serving_continuous_beats_static_by_1_5x(serving_bench_run):
+    """The acceptance floor: iteration-level admission must clear 1.5x the
+    static-gang QPS on the mixed-length A/B (3:1 short:long, so every
+    static gang drains behind one straggler)."""
+    rows = [json.loads(l) for l in serving_bench_run.stdout.splitlines()
+            if l.startswith("{")]
+    ab = [r for r in rows
+          if r["metric"] == "serving_continuous_vs_static"][0]
+    assert ab["continuous_qps"] > 0 and ab["static_qps"] > 0, ab
+    assert ab["value"] >= 1.5, ab
+    lane = [l for l in serving_bench_run.stderr.splitlines()
+            if l.startswith("# serving lane:")]
+    assert lane and "OK 1.5x floor" in lane[0], \
+        serving_bench_run.stderr[-2000:]
+
+
+def test_serving_phase_skips_others(serving_bench_run):
+    err = serving_bench_run.stderr
+    assert "# tpu:// sweep" not in err
+    assert "# batch lane (" not in err
+    assert "# device lane" not in err
+
+
 def test_zero_copy_counters_emitted(bench_run):
     err = bench_run.stderr
     zc = [l for l in err.splitlines()
@@ -251,13 +306,16 @@ def test_profile_budget_table_and_ratio(profile_bench_run):
 
 def test_sampler_overhead_under_two_pct_at_default_hz():
     """The always-on rate must be affordable: sampling a live 64B echo
-    lane at the default continuous hz costs <2% of wall time."""
+    lane at the default continuous hz costs <2% of wall time — with a live
+    serving engine folded in, so the guard also prices the engine's
+    registered step-loop thread and the g_serving_* series rings."""
     import time
 
     from brpc_tpu import flags as _flags
     from brpc_tpu.profiling.sampler import ProfileSession
     from brpc_tpu.proto import echo_pb2
     from brpc_tpu.rpc import Channel, ChannelOptions, Server, Service, Stub
+    from test_serving import _stub_engine
 
     ECHO = echo_pb2.DESCRIPTOR.services_by_name["EchoService"]
 
@@ -277,7 +335,13 @@ def test_sampler_overhead_under_two_pct_at_default_hz():
     assert _flags.get("var_series_enabled")
     ticks_before = global_series().ticks
     srv = Server().add_service(EchoImpl()).start("tpu://127.0.0.1:0/0")
+    engine = _stub_engine(step_s=0.002)
     try:
+        # decode activity spanning the whole sampled window: the engine's
+        # "serving" thread is profiler-registered, so its stacks are in
+        # every tick the guard prices
+        for _ in range(3):
+            assert engine.submit(engine.model.synth_prompt(4), 500)[0] == 0
         ch = Channel(ChannelOptions(protocol="trpc_std", timeout_ms=10000))
         ch.init(str(srv.listen_endpoint()))
         stub = Stub(ch, ECHO)
@@ -290,9 +354,11 @@ def test_sampler_overhead_under_two_pct_at_default_hz():
             stub.Echo(req)
         wall = time.monotonic() - t0
         prof = sess.stop()
+        assert engine.steps > 0, "serving engine never stepped in-window"
     finally:
         srv.stop()
         srv.join(timeout=2)
+        engine.stop()
     overhead = prof.sample_time_s / wall
     assert overhead < 0.02, (
         f"sampler self-time {overhead:.2%} of wall at {hz:g}hz "

@@ -17,6 +17,7 @@ EXPECTED_RULES = {
     "no-blocking-in-poller", "acquire-release", "monotonic-clock",
     "lock-order", "version-guard", "metric-flag-hygiene", "bounded-spin",
     "named-thread", "cross-process-ownership", "metric-churn",
+    "no-per-token-host-sync",
 }
 
 
@@ -689,6 +690,104 @@ class TestMetricChurn:
             from brpc_tpu.metrics.reducer import Adder
             def __init__(self):
                 self.n = Adder()  # tpulint: disable=metric-churn
+            """}, rules=self.RULE)
+        assert res.clean
+        assert len(res.suppressed) == 1
+
+
+class TestNoPerTokenHostSync:
+    RULE = ["no-per-token-host-sync"]
+
+    def test_item_in_decode_loop_fires(self, tmp_path):
+        res = _lint(tmp_path, {"serving/engine.py": """\
+            def step(self, batch):
+                for seq in batch:
+                    tok = self.model.decode_one(seq)
+                    seq.append(tok.item())
+            """}, rules=self.RULE)
+        assert [f.rule for f in res.findings] == ["no-per-token-host-sync"]
+        assert res.findings[0].line == 4
+
+    def test_block_until_ready_in_while_fires(self, tmp_path):
+        res = _lint(tmp_path, {"serving/model.py": """\
+            def generate(self, seq):
+                while not seq.done:
+                    nxt = self._decode(seq)
+                    nxt.block_until_ready()
+            """}, rules=self.RULE)
+        assert not res.clean
+        assert "block_until_ready" in res.findings[0].message
+
+    def test_device_get_and_asarray_in_loop_fire(self, tmp_path):
+        res = _lint(tmp_path, {"serving/engine.py": """\
+            import jax
+            import numpy as np
+            def drain(self, seqs):
+                for s in seqs:
+                    a = jax.device_get(s.logits)
+                    b = np.asarray(s.next_token)
+            """}, rules=self.RULE)
+        assert len(res.findings) == 2
+
+    def test_one_sync_per_step_outside_loop_passes(self, tmp_path):
+        # the engine's own discipline: build host inputs in the loop,
+        # ONE materialization after the fused call
+        res = _lint(tmp_path, {"serving/model.py": """\
+            import numpy as np
+            def decode_step(self, tokens, tables):
+                slot_tables = np.zeros((8, 64))
+                for i, t in enumerate(tables):
+                    slot_tables[i] = self._slots_for(t)
+                nxt = self._fn(tokens, slot_tables)
+                return np.asarray(nxt)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_jnp_asarray_in_loop_passes(self, tmp_path):
+        # device-side asarray is a placement op, not a host sync
+        res = _lint(tmp_path, {"serving/model.py": """\
+            import jax.numpy as jnp
+            def stage(self, chunks):
+                for c in chunks:
+                    x = jnp.asarray(c)
+                    self.push(x)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_same_code_outside_serving_scope_passes(self, tmp_path):
+        res = _lint(tmp_path, {"tpu/device_lane.py": """\
+            import numpy as np
+            def pump(self, arrs):
+                for a in arrs:
+                    out = np.asarray(a)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_sync_in_nested_def_not_charged_to_loop(self, tmp_path):
+        # the closure runs when called, not per iteration of this loop
+        res = _lint(tmp_path, {"serving/engine.py": """\
+            def arm(self, seqs):
+                for s in seqs:
+                    def finish(r, s=s):
+                        return r.item()
+                    s.on_done = finish
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_nested_loops_report_once(self, tmp_path):
+        res = _lint(tmp_path, {"serving/engine.py": """\
+            def sweep(self, groups):
+                for g in groups:
+                    for s in g:
+                        v = s.logits.item()
+            """}, rules=self.RULE)
+        assert len(res.findings) == 1
+
+    def test_suppression_honored(self, tmp_path):
+        res = _lint(tmp_path, {"serving/debug.py": """\
+            def trace_tokens(self, seqs):
+                for s in seqs:
+                    print(s.tok.item())  # tpulint: disable=no-per-token-host-sync
             """}, rules=self.RULE)
         assert res.clean
         assert len(res.suppressed) == 1
