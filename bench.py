@@ -50,6 +50,9 @@ HEADLINE_SIZE = 1 << 20
 # (pre fastpath-stack; BENCH_r03.json) — the qps the latency work is
 # measured against
 BASELINE_64B_QPS = 1692.0
+# isolated per-RPC device dispatch rate on the tunneled chip (BENCH_r05);
+# the coalesced per-step dispatch path is measured against this
+BASELINE_DEVICE_OPS = 7222.0
 
 # (payload bytes, threads, calls per thread)
 SWEEP = [
@@ -381,26 +384,39 @@ def bench_batch_lane():
         srv.close()
 
 
-def _serving_engine_qps(scheduling: str, n_requests: int) -> float:
+def _serving_engine_qps(scheduling: str, n_requests: int,
+                        sharded: bool = False):
     """In-process half of the serving lane: one engine, one mixed-length
     workload (mostly short 4-token generations with a long 64-token one
     every 4th request — each static gang carries exactly one straggler;
-    all submitted up front); returns requests/sec. Static gang scheduling
-    drains a whole batch before admitting the next, so every short
-    request waits out the longest gang member; continuous batching
-    refills freed slots between decode steps (brpc_tpu/serving/engine.py).
-    Identical model/engine configs, so the ratio isolates the scheduler."""
+    all submitted up front); returns (requests/sec, tokens/sec). Static
+    gang scheduling drains a whole batch before admitting the next, so
+    every short request waits out the longest gang member; continuous
+    batching refills freed slots between decode steps
+    (brpc_tpu/serving/engine.py). Identical model/engine configs, so the
+    ratio isolates the scheduler. ``sharded=True`` runs the mesh stack
+    (MeshTransformer + ShardedKVCache over the dp/sp/tp serving mesh) —
+    on one device the mesh degenerates to 1x1x1, so the lane works under
+    any XLA_FLAGS device count."""
     from brpc_tpu.serving import (EngineConfig, KVCacheConfig, ModelConfig,
                                   PagedKVCache, ServingEngine,
                                   TinyTransformer)
 
     cfg = ModelConfig(vocab=256, d_model=32, n_heads=2, n_layers=2)
-    kv = PagedKVCache(KVCacheConfig(block_size=16, num_blocks=256),
-                      cfg.n_layers, cfg.kv_dim)
-    model = TinyTransformer(cfg, kv)
+    if sharded:
+        from brpc_tpu.serving import MeshTransformer, ShardedKVCache
+
+        kv = ShardedKVCache(KVCacheConfig(block_size=16, num_blocks=256),
+                            cfg.n_layers, cfg.kv_dim)
+        model = MeshTransformer(cfg, kv)
+    else:
+        kv = PagedKVCache(KVCacheConfig(block_size=16, num_blocks=256),
+                          cfg.n_layers, cfg.kv_dim)
+        model = TinyTransformer(cfg, kv)
     engine = ServingEngine(model, kv, EngineConfig(
         max_batch=4, token_budget=256, scheduling=scheduling,
         idle_wait_s=0.005)).start()
+    tokens = sum(64 if i % 4 == 3 else 4 for i in range(n_requests))
 
     def run(n):
         evs = []
@@ -416,7 +432,8 @@ def _serving_engine_qps(scheduling: str, n_requests: int) -> float:
         for ev in evs:
             if not ev.wait(300):
                 raise RuntimeError(f"serving A/B stalled ({scheduling})")
-        return n / (time.perf_counter() - t0)
+        wall = time.perf_counter() - t0
+        return n / wall, tokens / wall
 
     try:
         # two warmup rounds of the EXACT timed workload: the queue-depth
@@ -432,12 +449,45 @@ def _serving_engine_qps(scheduling: str, n_requests: int) -> float:
         model.close()
 
 
+def _device_op_rate() -> tuple:
+    """Coalesced per-step device dispatch rate, measured in-process on
+    the sim lane: one small HBM-resident buffer, transient copies queued
+    through DeviceStore.copy_coalesced (the per-step batch API the
+    serving engine rides) so the dispatcher thread fuses them into O(1)
+    compiled programs instead of per-op ~7ms command latencies. Returns
+    (op_rate, ops). Hardware counterpart: tests_hw/bench.py drives the
+    same path over the Copy RPC's nbytes=-k rider against the real chip
+    and holds the 14.5k op/s floor (BENCH_r05 isolated-dispatch
+    baseline: 7.2k op/s)."""
+    from brpc_tpu.tpu.device_lane import (DispatchCounter, global_store,
+                                          step_dispatch)
+
+    store = global_store()
+    handle, _ = store.put(b"\x00" * 1024)
+    try:
+        store.copy_coalesced(handle, 64)  # warmup: dispatcher + jit cache
+        store.fence()
+        total_ops = 2048 if QUICK else 16384
+        batch = 256  # one "step" worth of device ops per Python dispatch
+        before = step_dispatch.snapshot()
+        t0 = time.perf_counter()
+        for _ in range(total_ops // batch):
+            store.copy_coalesced(handle, batch)
+        store.fence()
+        wall = time.perf_counter() - t0
+        _, ops, _ = DispatchCounter.delta(before, step_dispatch.snapshot())
+        return ops / wall, ops
+    finally:
+        store.free(handle)
+
+
 def bench_serving_lane():
     """Serving plane (brpc_tpu/serving/): streamed generations over the
     RPC path against a pre-warmed child server — aggregate tokens/sec and
     TTFT percentiles measured at stream-frame arrival — then the
     in-process continuous-vs-static scheduling A/B on mixed-length
-    traffic. Emits the three serving JSON metric lines."""
+    traffic over the SHARDED mesh stack, plus the coalesced device
+    dispatch-rate probe. Emits the five serving JSON metric lines."""
     from brpc_tpu.proto import serving_pb2
     from brpc_tpu.rpc import Channel, ChannelOptions, Controller, Stub
     from brpc_tpu.rpc.stream import (StreamOptions, stream_close,
@@ -509,17 +559,27 @@ def bench_serving_lane():
     finally:
         srv.close()
 
+    # the scheduling A/B runs on the SHARDED stack (mesh prefill/decode +
+    # per-device KV pools): the 1.5x continuous-vs-static floor must hold
+    # with sharding on, or the mesh lowering broke iteration-level refill
     n_ab = 16 if QUICK else 32
-    cont_qps = _serving_engine_qps("continuous", n_ab)
-    stat_qps = _serving_engine_qps("static", n_ab)
+    cont_qps, cont_tps = _serving_engine_qps("continuous", n_ab,
+                                             sharded=True)
+    stat_qps, _ = _serving_engine_qps("static", n_ab, sharded=True)
     ratio = cont_qps / max(stat_qps, 1e-9)
+    op_rate, n_ops = _device_op_rate()
+    import jax as _jax
+    n_dev = len(_jax.devices())
     p50 = _percentile(lat, 0.5) * 1e3
     p99 = _percentile(lat, 0.99) * 1e3
     print(f"# serving lane: {threads}x{calls} streamed generations "
           f"tokens/s={tps:,.0f} ttft p50={p50:.1f}ms p99={p99:.1f}ms | "
-          f"A/B {n_ab} mixed-length reqs: continuous={cont_qps:.1f} req/s "
+          f"sharded A/B ({n_dev} dev) {n_ab} mixed-length reqs: "
+          f"continuous={cont_qps:.1f} req/s "
           f"static={stat_qps:.1f} req/s ratio={ratio:.2f}x "
-          f"({'OK' if ratio >= 1.5 else 'BELOW'} 1.5x floor)",
+          f"({'OK' if ratio >= 1.5 else 'BELOW'} 1.5x floor) | "
+          f"coalesced device dispatch: {n_ops} ops at {op_rate:,.0f} op/s "
+          f"(isolated-dispatch baseline {BASELINE_DEVICE_OPS:,.0f})",
           file=sys.stderr)
     print(json.dumps({
         "metric": "serving_tokens_per_sec",
@@ -538,6 +598,19 @@ def bench_serving_lane():
         "unit": "x",
         "continuous_qps": round(cont_qps, 1),
         "static_qps": round(stat_qps, 1),
+    }))
+    print(json.dumps({
+        "metric": "serving_sharded_tokens_per_s",
+        "value": round(cont_tps, 1),
+        "unit": "tokens/s",
+        "devices": n_dev,
+    }))
+    print(json.dumps({
+        "metric": "device_op_rate",
+        "value": round(op_rate, 1),
+        "unit": "op/s",
+        "ops": n_ops,
+        "vs_baseline": BASELINE_DEVICE_OPS,
     }))
     return ratio
 

@@ -877,6 +877,83 @@ def rule_no_per_token_host_sync(pkg: Package) -> List[Finding]:
     return out
 
 
+# --------------------------------------------------------------------------
+# Rule 12: no-per-op-step-dispatch
+# --------------------------------------------------------------------------
+# The sharded serving plane's dispatch contract (PR 14, docs/serving.md):
+# per-step device work collapses into ONE fused launch — the decode batch
+# is one shard_map program across the whole mesh, and bulk device copies
+# ride the device lane's coalescing queue (DeviceStore.copy(transient=True)
+# / copy_coalesced), which the dispatcher thread fuses into pow2-batched
+# programs. Issuing a SYNCHRONOUS device dispatch per item of a loop —
+# store.copy() without transient=True, a stub .Copy() RPC per element,
+# jax.device_put per element — is the ~7ms-per-op pattern the coalesced
+# path exists to kill (tpu/device_lane.py's measured isolated-vs-fused
+# gap). Scope: serving/ and the tpu/ device lane + streams. Transient
+# copies are exempt: they ENTER the coalescing queue, which is the point.
+
+_STEP_DISPATCH_SCOPE_PREFIXES = ("serving/", "tpu/device_lane.py",
+                                 "tpu/device_stream.py")
+
+
+def _per_op_dispatch_call(call: ast.Call) -> Optional[str]:
+    """Message when this call issues one synchronous device dispatch per
+    loop iteration, else None."""
+    name = attr_chain(call.func)
+    if name is None:
+        return None
+    parts = name.split(".")
+    last = parts[-1]
+    if last == "copy" and len(parts) > 1 and "store" in parts[-2].lower():
+        for kw in call.keywords:
+            if kw.arg == "transient" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is True:
+                return None  # rides the coalescing queue — the async path
+        return (f"{name}() dispatches one device program per iteration "
+                f"(~ms each isolated); use transient=True or "
+                f"copy_coalesced to ride the fused dispatch queue")
+    if last == "Copy" and len(parts) > 1:
+        return (f"{name}() issues one Copy RPC -> one device dispatch per "
+                f"iteration; batch with nbytes=-k (coalesced rider) or "
+                f"re-issue from the response callback chain")
+    if last == "device_put":
+        return (f"{name}() stages one host->device transfer per "
+                f"iteration; stack the batch and transfer once")
+    return None
+
+
+@register_rule(
+    "no-per-op-step-dispatch",
+    "serving/ and device-lane code must not issue a synchronous device "
+    "dispatch (store.copy without transient=True, stub.Copy, device_put) "
+    "per iteration of a loop — per-step work is ONE fused launch")
+def rule_no_per_op_step_dispatch(pkg: Package) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in pkg.files:
+        if not in_scope(sf.rel, prefixes=_STEP_DISPATCH_SCOPE_PREFIXES):
+            continue
+        seen: Set[Tuple[int, int]] = set()
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            for child in node.body + node.orelse:
+                for sub in ast.walk(child):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        # nested defs don't dispatch per iteration of
+                        # THIS loop; their own loops are walked separately
+                        break
+                    if isinstance(sub, ast.Call):
+                        msg = _per_op_dispatch_call(sub)
+                        key = (sub.lineno, sub.col_offset)
+                        if msg is not None and key not in seen:
+                            seen.add(key)
+                            out.append(Finding(
+                                "no-per-op-step-dispatch", sf.rel,
+                                sub.lineno, msg))
+    return out
+
+
 @register_rule(
     "metric-churn",
     "no metric construction (Adder/LatencyRecorder/Window/...) or expose() "

@@ -668,6 +668,25 @@ def serving_service(server, http: HttpMessage):
                    f"watermark={kv['watermark']:.0%}, "
                    f"block_size={kv['block_size']}, "
                    f"sequences={kv['sequences']}")
+        # sharded pools: per-device occupancy, per-shard step latency,
+        # and which shard owns each live sequence's block table
+        if "shards" in kv:
+            out.append(f"  sharded: n_shards={kv['n_shards']} "
+                       f"skew={kv['shard_skew']:.3f}")
+            steps = s.get("shard_steps", {})
+            for sh in kv["shards"]:
+                st = steps.get(sh["shard"], {})
+                out.append(
+                    f"    [shard {sh['shard']}] "
+                    f"{sh['blocks_used']}/{sh['blocks_total']} blocks "
+                    f"({sh['used_ratio']:.0%}) seqs={sh['sequences']} "
+                    f"step_us last={st.get('last_us', 0)} "
+                    f"avg={st.get('avg_us', 0)} "
+                    f"devices={','.join(sh['devices'])}")
+            if kv.get("shard_map"):
+                pairs = " ".join(f"{sid}->{sh}"
+                                 for sid, sh in kv["shard_map"].items())
+                out.append(f"    shard_map: {pairs}")
     return 200, CONTENT_TEXT, "\n".join(out) + "\n"
 
 
@@ -713,4 +732,5 @@ register_builtin("dump", dump_service,
                  "dump files")
 register_builtin("serving", serving_service,
                  "serving engines: batch occupancy, kv watermark, queue "
-                 "depth, step timings (?format=json)")
+                 "depth, step timings, per-shard occupancy/latency "
+                 "(?format=json)")

@@ -265,3 +265,9 @@ shard_vars_interval_s = define(
     "shard_vars_interval_s", 1.0,
     "seconds between W_VARS windowed var snapshots a shard worker ships "
     "to the parent for fleet-wide /vars aggregation", validator=_positive)
+serving_shard_skew_ratio = define(
+    "serving_shard_skew_ratio", 0.25,
+    "serving_shard_skew watch rule fires when any KV shard's occupancy "
+    "exceeds its fleet mean by more than this ratio (reloadable: the "
+    "rule reads the flag at every tick)",
+    validator=lambda v: 0.0 < v <= 1.0)

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from brpc_tpu.metrics.reducer import Adder
 from brpc_tpu.metrics.series import SeriesRegistry, global_series
@@ -50,7 +50,8 @@ class WatchRule:
     """One named condition over a variable's 1-second series tier."""
 
     def __init__(self, name: str, var: str, kind: str, op: str, value: float,
-                 window_s: int = 10, for_ticks: int = 1, clear_ticks: int = 3):
+                 window_s: int = 10, for_ticks: int = 1, clear_ticks: int = 3,
+                 value_fn: Optional[Callable[[], float]] = None):
         if kind not in (KIND_THRESHOLD, KIND_DELTA, KIND_RATE):
             raise ValueError(f"unknown watch kind {kind!r}")
         if op not in _OPS:
@@ -62,6 +63,10 @@ class WatchRule:
         self.kind = kind
         self.op = op
         self.value = value
+        # reloadable bound: when set, the comparison value is re-read every
+        # tick (e.g. from a runtime flag), so /flags?setvalue= retunes the
+        # rule without re-installing it; `value` stays as the fallback
+        self.value_fn = value_fn
         self.window_s = window_s
         self.for_ticks = for_ticks
         self.clear_ticks = clear_ticks
@@ -103,7 +108,7 @@ class WatchRule:
             self.state = STATE_NO_DATA
             return None
         self.observed = measured
-        cond = _OPS[self.op](measured, self.value)
+        cond = _OPS[self.op](measured, self.bound())
         if cond:
             self.true_streak += 1
             self.false_streak = 0
@@ -124,9 +129,17 @@ class WatchRule:
         self.last_transition_s = time.time()  # tpulint: disable=monotonic-clock
         return new_state
 
+    def bound(self) -> float:
+        if self.value_fn is None:
+            return self.value
+        try:
+            return float(self.value_fn())
+        except Exception:
+            return self.value
+
     def condition(self) -> str:
         return f"{self.kind}({self.var}, {self.window_s}s) " \
-               f"{self.op} {self.value:g}"
+               f"{self.op} {self.bound():g}"
 
     def to_dict(self) -> dict:
         return {
@@ -134,7 +147,7 @@ class WatchRule:
             "var": self.var,
             "kind": self.kind,
             "op": self.op,
-            "value": self.value,
+            "value": self.bound(),
             "window_s": self.window_s,
             "state": self.state,
             "observed": self.observed,
@@ -259,3 +272,11 @@ def install_default_rules() -> None:
     w.add(WatchRule(
         "serving_kv_exhaustion", "g_serving_kv_admission_rejects",
         KIND_DELTA, ">=", 1, window_s=10, for_ticks=1, clear_ticks=5))
+    # sharded serving: one KV shard filling while its siblings idle means
+    # routing (or a hot sequence) is concentrating load — the bound is
+    # the reloadable serving_shard_skew_ratio flag
+    from brpc_tpu import flags as _flags
+    w.add(WatchRule(
+        "serving_shard_skew", "g_serving_kv_shard_skew",
+        KIND_THRESHOLD, ">", 0.25, window_s=10, for_ticks=2, clear_ticks=5,
+        value_fn=lambda: _flags.get("serving_shard_skew_ratio")))

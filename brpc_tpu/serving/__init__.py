@@ -17,16 +17,38 @@ mechanism:
   admitted *between* decode steps (continuous batching).
 - :mod:`brpc_tpu.serving.service` — the LlmService RPC surface with
   per-request token streaming over the Stream API.
+- :mod:`brpc_tpu.serving.mesh_model` + the sharded KV classes — the
+  mesh-sharded lane: per-device KV pools over the serving mesh's ``dp``
+  axis, shard_map prefill/decode that keeps each engine step at ONE
+  fused launch + ONE host sync across the whole mesh.
+- :mod:`brpc_tpu.serving.router` — client-side shard routing: Generate
+  lands on the owning partition through PartitionChannel; shard failures
+  come back retriable (EFAILEDSOCKET).
 """
 
-from brpc_tpu.serving.kv_cache import KVCacheConfig, PagedKVCache
+from brpc_tpu.serving.kv_cache import (KVCacheConfig, PagedKVCache,
+                                       ShardedKVCache, ShardTable)
 from brpc_tpu.serving.model import ModelConfig, TinyTransformer
 from brpc_tpu.serving.engine import EngineConfig, ServingEngine, active_engines
 from brpc_tpu.serving.service import LlmServingService
 
+
+def __getattr__(name):
+    # MeshTransformer / ShardedLlmChannel import lazily: they pull in the
+    # mesh + combo-channel stacks, which plain single-device users of
+    # this package never need at import time
+    if name == "MeshTransformer":
+        from brpc_tpu.serving.mesh_model import MeshTransformer
+        return MeshTransformer
+    if name == "ShardedLlmChannel":
+        from brpc_tpu.serving.router import ShardedLlmChannel
+        return ShardedLlmChannel
+    raise AttributeError(name)
+
+
 __all__ = [
-    "KVCacheConfig", "PagedKVCache",
-    "ModelConfig", "TinyTransformer",
+    "KVCacheConfig", "PagedKVCache", "ShardedKVCache", "ShardTable",
+    "ModelConfig", "TinyTransformer", "MeshTransformer",
     "EngineConfig", "ServingEngine", "active_engines",
-    "LlmServingService",
+    "LlmServingService", "ShardedLlmChannel",
 ]

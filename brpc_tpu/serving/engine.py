@@ -171,6 +171,9 @@ class ServingEngine:
         self.tokens_generated = 0
         self.last_step_us = 0.0
         self._occupancy_sum = 0
+        # per-shard decode attribution: shard -> [steps, total_us,
+        # last_us, seq_steps] (only shards with live sequences tick)
+        self._shard_step: Dict[int, List[float]] = {}
         with _engines_lock:
             _engines.append(self)
 
@@ -233,14 +236,17 @@ class ServingEngine:
                 return errors.EOVERCROWDED, None
             # watermark backpressure counts queued-but-unadmitted prefill
             # tokens too, else a burst overcommits the pool before the
-            # step loop catches up
+            # step loop catches up. The sequence exists before the check
+            # so a sharded pool can route it (route_key -> owning shard's
+            # watermark; the single-pool cache ignores the key).
+            seq = Sequence(prompt, max_new_tokens, stop_token, cntl, done,
+                           stream_id)
             queued = sum(s.context_len() for s in self._waiting)
-            if not self.kv.can_admit(queued + len(prompt)):
+            if not self.kv.can_admit(queued + len(prompt),
+                                     route_key=seq.seq_id):
                 self.kv.note_rejected()
                 g_serving_rejected.put(1)
                 return errors.EOVERCROWDED, None
-            seq = Sequence(prompt, max_new_tokens, stop_token, cntl, done,
-                           stream_id)
             self._waiting.append(seq)
             self._cv.notify()
         return 0, seq
@@ -350,8 +356,33 @@ class ServingEngine:
                 for s in batch:
                     tables.append(self.kv.extend_sequence(
                         s.seq_id, s.context_len()))
+                # dispatch-count invariant: under an armed ledger, the
+                # whole decode batch — across every mesh shard — must
+                # cost exactly ONE fused launch + ONE host sync
+                audit = (getattr(self.model, "FUSED_STEP", False)
+                         and getattr(self.kv, "_check", False))
+                if audit:
+                    from brpc_tpu.tpu.device_lane import step_dispatch
+                    d_before = step_dispatch.snapshot()
                 nxt = self.model.decode_step(tokens, positions, tables)
+                if audit:
+                    launches, _, syncs = step_dispatch.delta(
+                        d_before, step_dispatch.snapshot())
+                    assert (launches, syncs) == (1, 1), (
+                        f"decode step dispatched {launches} launches / "
+                        f"{syncs} host syncs for {len(batch)} seqs; the "
+                        f"step contract is exactly (1, 1)")
                 decode_us = (time.perf_counter_ns() - td0) / 1000.0
+                shards_live: Dict[int, int] = {}
+                for tbl in tables:
+                    sh = getattr(tbl, "shard", 0)
+                    shards_live[sh] = shards_live.get(sh, 0) + 1
+                for sh, n_live in shards_live.items():
+                    st = self._shard_step.setdefault(sh, [0, 0.0, 0.0, 0])
+                    st[0] += 1
+                    st[1] += decode_us
+                    st[2] = decode_us
+                    st[3] += n_live
                 for s, tok in zip(batch, nxt):
                     self._append_token(s, int(tok))
                     span = getattr(s.cntl, "span", None)
@@ -487,5 +518,12 @@ class ServingEngine:
             "ttft_us_p50": g_serving_ttft.latency_percentile(0.5),
             "ttft_us_p99": g_serving_ttft.latency_percentile(0.99),
             "itl_us_p50": g_serving_itl.latency_percentile(0.5),
+            "shard_steps": {
+                sh: {"steps": int(st[0]),
+                     "avg_us": round(st[1] / st[0], 1) if st[0] else 0.0,
+                     "last_us": round(st[2], 1),
+                     "seq_steps": int(st[3])}
+                for sh, st in sorted(self._shard_step.items())
+            },
             "kv": kv,
         }

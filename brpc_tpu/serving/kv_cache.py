@@ -25,6 +25,19 @@ Under ``BRPC_TPU_CHECK=1`` every alloc/free re-audits the invariants
 :meth:`PagedKVCache.assert_idle` gives teardown the same discipline the
 CreditLedger gives tunnel windows: a chaos-killed generation must return
 every block before the engine reports the pool whole.
+
+**Sharded mode** (:class:`ShardedKVCache`): one block pool per ``dp``
+shard of the serving mesh. Each shard keeps its own ledger-only
+:class:`PagedKVCache` (free list, refcounts, watermark, CHECK audits —
+per pool, exactly as single-device), while the device-resident K/V live
+as ONE stacked ``(dp, layers, slots, kv_dim)`` pair sharded over the
+``dp`` axis, so every shard's pool is resident on its own devices and
+the fused decode program still launches ONCE for the whole mesh. Block
+tables name (shard, block) pairs — a :class:`ShardTable` is the block-id
+list plus the owning shard — and a sequence routes to its shard with the
+same splitmix64 ``shard_for`` the dispatch plane uses (VersionedPool
+``version << 32`` cids must spread, not pin to shard 0). fork/extend/
+free stay device-local: they only ever touch the owning shard's ledger.
 """
 
 from __future__ import annotations
@@ -32,6 +45,8 @@ from __future__ import annotations
 import threading
 import weakref
 from typing import Dict, List, Optional, Tuple
+
+import numpy as np
 
 from brpc_tpu.metrics.reducer import Adder
 from brpc_tpu.metrics.status import PassiveStatus
@@ -75,26 +90,36 @@ class KVCacheConfig:
 
 
 class PagedKVCache:
-    """Block manager + the device-resident K/V pools behind it."""
+    """Block manager + the device-resident K/V pools behind it.
+
+    ``device_pools=False`` runs ledger-only: the full block/refcount/
+    watermark/audit machinery with no device arrays of its own — how
+    :class:`ShardedKVCache` gives every shard its own ledger while the
+    device residency lives in the stacked per-mesh pools."""
 
     def __init__(self, config: KVCacheConfig, layers: int, kv_dim: int,
-                 store=None, dtype=None):
-        import jax.numpy as jnp
-
-        from brpc_tpu.tpu.device_lane import global_store
-
+                 store=None, dtype=None, device_pools: bool = True):
         self.config = config
         self.layers = layers
         self.kv_dim = kv_dim
-        self.store = store if store is not None else global_store()
         self._lock = threading.Lock()
-        # physical block 0 is scratch (pad scatter target): +1 below
-        slots = (config.num_blocks + 1) * config.block_size
-        dtype = dtype or jnp.float32
-        self.k_pool = jnp.zeros((layers, slots, kv_dim), dtype=dtype)
-        self.v_pool = jnp.zeros((layers, slots, kv_dim), dtype=dtype)
-        self.k_handle, _ = self.store.adopt(self.k_pool)
-        self.v_handle, _ = self.store.adopt(self.v_pool)
+        self.store = store
+        self.k_pool = self.v_pool = None
+        self.k_handle = self.v_handle = 0
+        if device_pools:
+            import jax.numpy as jnp
+
+            from brpc_tpu.tpu.device_lane import global_store
+
+            if store is None:
+                self.store = global_store()
+            # physical block 0 is scratch (pad scatter target): +1 below
+            slots = (config.num_blocks + 1) * config.block_size
+            dtype = dtype or jnp.float32
+            self.k_pool = jnp.zeros((layers, slots, kv_dim), dtype=dtype)
+            self.v_pool = jnp.zeros((layers, slots, kv_dim), dtype=dtype)
+            self.k_handle, _ = self.store.adopt(self.k_pool)
+            self.v_handle, _ = self.store.adopt(self.v_pool)
         self._free: List[int] = list(range(config.num_blocks, 0, -1))
         self._ref: Dict[int, int] = {}
         self._tables: Dict[int, List[int]] = {}
@@ -105,7 +130,10 @@ class PagedKVCache:
             self._check = bool(runtime_check.ACTIVE)
         except Exception:
             pass
-        _caches.add(self)
+        if device_pools:
+            # ledger-only shards are accounted by their ShardedKVCache,
+            # not double-counted in the fleet totals
+            _caches.add(self)
 
     # ------------------------------------------------------------- geometry
     @property
@@ -134,7 +162,7 @@ class PagedKVCache:
         return max(1, (ntokens + bs - 1) // bs)
 
     # ------------------------------------------------------------ admission
-    def can_admit(self, ntokens: int) -> bool:
+    def can_admit(self, ntokens: int, route_key: Optional[int] = None) -> bool:
         """Watermark admission: the pool after this sequence's prefill
         blocks must stay at or under ``watermark`` of capacity, leaving
         the slack as decode headroom for sequences already running."""
@@ -302,8 +330,9 @@ class PagedKVCache:
                                  "; ".join(problems))
 
     def close(self) -> None:
-        self.store.free(self.k_handle)
-        self.store.free(self.v_handle)
+        if self.k_handle:
+            self.store.free(self.k_handle)
+            self.store.free(self.v_handle)
 
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
@@ -317,3 +346,255 @@ class PagedKVCache:
                 "used_ratio": used / float(self.config.num_blocks),
                 "sequences": len(self._tables),
             }
+
+
+class ShardTable(list):
+    """A block table that knows which dp shard owns it — the (device,
+    block) naming of the sharded plane. It IS the plain block-id list
+    everywhere the single-device path expects one; the mesh model reads
+    ``.shard`` to place the sequence's compute and K/V scatter."""
+
+    def __init__(self, shard: int, blocks):
+        super().__init__(blocks)
+        self.shard = shard
+
+
+_sharded: "weakref.WeakSet[ShardedKVCache]" = weakref.WeakSet()
+
+
+def _fleet_skew() -> float:
+    """Worst per-device occupancy excess over its cache's fleet mean —
+    the quantity the serving_shard_skew watch rule fires on. 0 when
+    perfectly balanced (or nothing sharded is live)."""
+    worst = 0.0
+    for c in list(_sharded):
+        ratios = [p.used_ratio() for p in c.pools]
+        if ratios:
+            worst = max(worst, max(ratios) - sum(ratios) / len(ratios))
+    return worst
+
+
+g_serving_kv_shard_skew = PassiveStatus(_fleet_skew) \
+    .expose("g_serving_kv_shard_skew")
+g_serving_kv_shard_skew.prometheus_type = "gauge"
+
+
+class ShardedKVCache:
+    """Per-device block pools over the serving mesh's ``dp`` axis.
+
+    One ledger-only :class:`PagedKVCache` per shard carries the block
+    accounting (watermark, refcounts, BRPC_TPU_CHECK audits — enforced
+    PER POOL), while the device-resident K/V are ONE stacked
+    ``(dp, layers, slots, kv_dim)`` array pair sharded over ``dp`` via
+    :func:`~brpc_tpu.tpu.mesh.named_sharding`, registered once in the
+    DeviceStore. Sequences route to shards with the dispatch plane's
+    splitmix64 ``shard_for`` (stable under VersionedPool cid reuse);
+    fork/extend/free only ever touch the owning shard's ledger."""
+
+    def __init__(self, config: KVCacheConfig, layers: int, kv_dim: int,
+                 mesh=None, store=None, dtype=None):
+        import jax
+        import jax.numpy as jnp
+
+        from brpc_tpu.shard.plane import shard_for
+        from brpc_tpu.tpu.device_lane import global_store
+        from brpc_tpu.tpu.mesh import named_sharding, serving_mesh
+
+        if mesh is None:
+            mesh = serving_mesh()
+        if "dp" not in mesh.axis_names:
+            raise ValueError(f"mesh {mesh.axis_names} has no dp axis")
+        self.config = config
+        self.layers = layers
+        self.kv_dim = kv_dim
+        self.mesh = mesh
+        self.n_shards = int(mesh.shape["dp"])
+        self.store = store if store is not None else global_store()
+        self._route = shard_for
+        self._lock = threading.Lock()
+        self.pools = [PagedKVCache(config, layers, kv_dim,
+                                   device_pools=False)
+                      for _ in range(self.n_shards)]
+        self._shard_of: Dict[int, int] = {}
+        slots = (config.num_blocks + 1) * config.block_size
+        dtype = dtype or jnp.float32
+        shape = (self.n_shards, layers, slots, kv_dim)
+        sharding = named_sharding(mesh, "dp")
+        self.k_pools = jax.device_put(jnp.zeros(shape, dtype=dtype),
+                                      sharding)
+        self.v_pools = jax.device_put(jnp.zeros(shape, dtype=dtype),
+                                      sharding)
+        self.k_handle, _ = self.store.adopt(self.k_pools)
+        self.v_handle, _ = self.store.adopt(self.v_pools)
+        _caches.add(self)   # fleet totals (/vars) see the aggregate
+        _sharded.add(self)  # skew gauge sees the per-shard spread
+
+    # ------------------------------------------------------------- geometry
+    @property
+    def num_blocks(self) -> int:
+        return self.config.num_blocks * self.n_shards
+
+    @property
+    def block_size(self) -> int:
+        return self.config.block_size
+
+    @property
+    def used_blocks(self) -> int:
+        return sum(p.used_blocks for p in self.pools)
+
+    @property
+    def free_blocks(self) -> int:
+        return sum(p.free_blocks for p in self.pools)
+
+    def used_ratio(self) -> float:
+        return self.used_blocks / float(self.num_blocks)
+
+    def blocks_for(self, ntokens: int) -> int:
+        return self.pools[0].blocks_for(ntokens)
+
+    # the CHECK arming surface tests use (kv._check = True) fans out to
+    # every shard ledger — the audit contract is per pool
+    @property
+    def _check(self) -> bool:
+        return any(p._check for p in self.pools)
+
+    @_check.setter
+    def _check(self, v: bool) -> None:
+        for p in self.pools:
+            p._check = v
+
+    # -------------------------------------------------------------- routing
+    def shard_of(self, seq_id: int) -> int:
+        """The dp shard owning (or that would own) a sequence. Live
+        sequences keep their pinned shard; new ones route by splitmix64,
+        so a retry re-submitted with the same id lands on the same pool."""
+        with self._lock:
+            pinned = self._shard_of.get(seq_id)
+        if pinned is not None:
+            return pinned
+        return self._route(seq_id, self.n_shards)
+
+    def _pool_of(self, seq_id: int) -> Optional[Tuple[int, PagedKVCache]]:
+        with self._lock:
+            shard = self._shard_of.get(seq_id)
+        if shard is None:
+            return None
+        return shard, self.pools[shard]
+
+    # ------------------------------------------------------------ admission
+    def can_admit(self, ntokens: int, route_key: Optional[int] = None) -> bool:
+        """Watermark admission against the OWNING shard's pool when the
+        routing key is known; against the fleet aggregate otherwise."""
+        if route_key is not None:
+            return self.pools[self.shard_of(route_key)].can_admit(ntokens)
+        need = self.blocks_for(ntokens)
+        limit = int(self.config.watermark * self.num_blocks)
+        return self.used_blocks + need <= limit
+
+    def note_rejected(self) -> None:
+        g_serving_kv_admission_rejects.put(1)
+
+    # ----------------------------------------------------------- block ops
+    def alloc_sequence(self, seq_id: int, ntokens: int) -> ShardTable:
+        shard = self.shard_of(seq_id)
+        table = self.pools[shard].alloc_sequence(seq_id, ntokens)
+        with self._lock:
+            self._shard_of[seq_id] = shard
+        return ShardTable(shard, table)
+
+    def extend_sequence(self, seq_id: int, new_len: int) -> ShardTable:
+        got = self._pool_of(seq_id)
+        if got is None:
+            raise KeyError(f"unknown sequence {seq_id}")
+        shard, pool = got
+        return ShardTable(shard, pool.extend_sequence(seq_id, new_len))
+
+    def fork_sequence(self, src_seq: int, dst_seq: int) -> ShardTable:
+        """Device-local fork: the child shares the parent's blocks, so it
+        MUST live on the parent's shard — the fork pins it there, not the
+        hash route."""
+        got = self._pool_of(src_seq)
+        if got is None:
+            raise KeyError(f"unknown sequence {src_seq}")
+        shard, pool = got
+        table = pool.fork_sequence(src_seq, dst_seq)
+        with self._lock:
+            self._shard_of[dst_seq] = shard
+        return ShardTable(shard, table)
+
+    def free_sequence(self, seq_id: int) -> int:
+        with self._lock:
+            shard = self._shard_of.pop(seq_id, None)
+        if shard is None:
+            return 0
+        return self.pools[shard].free_sequence(seq_id)
+
+    def block_table(self, seq_id: int) -> Optional[ShardTable]:
+        got = self._pool_of(seq_id)
+        if got is None:
+            return None
+        shard, pool = got
+        table = pool.block_table(seq_id)
+        return ShardTable(shard, table) if table is not None else None
+
+    def seq_len(self, seq_id: int) -> int:
+        got = self._pool_of(seq_id)
+        return got[1].seq_len(seq_id) if got else 0
+
+    def live_sequences(self) -> List[int]:
+        out: List[int] = []
+        for p in self.pools:
+            out.extend(p.live_sequences())
+        return sorted(out)
+
+    # ------------------------------------------------------------ pool swap
+    def update_pools(self, k_pools, v_pools) -> None:
+        """Install the post-step stacked pools (functional update output)
+        and re-point the DeviceStore handles — one swap per engine step
+        for the WHOLE mesh, not per shard."""
+        self.k_pools = k_pools
+        self.v_pools = v_pools
+        self.store.replace(self.k_handle, k_pools)
+        self.store.replace(self.v_handle, v_pools)
+
+    # ---------------------------------------------------------------- audit
+    def assert_idle(self, context: str = "") -> None:
+        for i, p in enumerate(self.pools):
+            where = f"shard {i}" + (f", {context}" if context else "")
+            p.assert_idle(where)
+        with self._lock:
+            if self._shard_of:
+                raise AssertionError(
+                    f"sharded kv not idle [{context}]: routing entries "
+                    f"for {sorted(self._shard_of)} still pinned")
+
+    def close(self) -> None:
+        self.store.free(self.k_handle)
+        self.store.free(self.v_handle)
+
+    def snapshot(self) -> Dict[str, object]:
+        used = self.used_blocks
+        total = self.num_blocks
+        dev_rows = np.asarray(self.mesh.devices).reshape(self.n_shards, -1)
+        shards = []
+        for i, p in enumerate(self.pools):
+            s = p.snapshot()
+            s["shard"] = i
+            s["devices"] = [str(d) for d in dev_rows[i]]
+            shards.append(s)
+        with self._lock:
+            shard_map = dict(sorted(self._shard_of.items()))
+        ratios = [s["used_ratio"] for s in shards]
+        return {
+            "block_size": self.config.block_size,
+            "blocks_total": total,
+            "blocks_used": used,
+            "blocks_free": total - used,
+            "watermark": self.config.watermark,
+            "used_ratio": used / float(total),
+            "sequences": sum(s["sequences"] for s in shards),
+            "n_shards": self.n_shards,
+            "shard_skew": max(ratios) - sum(ratios) / len(ratios),
+            "shards": shards,
+            "shard_map": shard_map,
+        }

@@ -73,8 +73,61 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def _rms(x):
+    import jax
+    import jax.numpy as jnp
+
+    return x * jax.lax.rsqrt(
+        jnp.mean(jnp.square(x), axis=-1, keepdims=True) + 1e-6)
+
+
+def _decode_body(cfg: ModelConfig, params, kpool, vpool, tokens, positions,
+                 slot_tables, B: int, L: int):
+    """The fused decode math for ONE device's pool slice — shared verbatim
+    by the single-device jit and the mesh shard_map body
+    (serving/mesh_model.py), so sharded greedy decode is token-identical
+    to single-device by construction.
+
+    tokens (B,), positions (B,), slot_tables (B, L): flat pool slot for
+    every context position (pads -> scratch block 0)."""
+    import jax
+    import jax.numpy as jnp
+
+    H, hd = cfg.n_heads, cfg.head_dim
+    x = params["embed"][tokens]                       # (B, D)
+    write = slot_tables[jnp.arange(B), positions]     # (B,)
+    mask = (jnp.arange(L)[None, :]
+            <= positions[:, None])                    # (B, L)
+    for l in range(cfg.n_layers):
+        h = _rms(x)
+        qkv = h @ params[f"wqkv{l}"]
+        q, k, vv = jnp.split(qkv, 3, axis=-1)
+        kpool = kpool.at[l, write].set(k)
+        vpool = vpool.at[l, write].set(vv)
+        ks = kpool[l][slot_tables]                    # (B, L, D)
+        vs = vpool[l][slot_tables]
+        qh = q.reshape(B, H, hd)
+        kh = ks.reshape(B, L, H, hd)
+        vh = vs.reshape(B, L, H, hd)
+        s = jnp.einsum("bhd,blhd->bhl", qh, kh) / np.sqrt(hd)
+        s = jnp.where(mask[:, None, :], s, -1e30)
+        patt = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bhl,blhd->bhd", patt, vh)
+        x = x + attn.reshape(B, -1) @ params[f"wo{l}"]
+        h2 = _rms(x)
+        x = x + jax.nn.relu(h2 @ params[f"w1{l}"]) @ params[f"w2{l}"]
+    logits = _rms(x) @ params["embed"].T              # (B, V)
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return kpool, vpool, nxt
+
+
 class TinyTransformer:
     """Weights + the fused prefill/decode programs over a PagedKVCache."""
+
+    # the step-dispatch contract the engine asserts under BRPC_TPU_CHECK:
+    # decode_step is ONE fused launch + ONE host materialization, counted
+    # through tpu/device_lane.step_dispatch
+    FUSED_STEP = True
 
     def __init__(self, config: ModelConfig, kv: PagedKVCache,
                  store=None, mesh=None):
@@ -223,10 +276,14 @@ class TinyTransformer:
         toks = np.zeros(bucket, dtype=np.int32)
         toks[:s] = tokens
         slots = self._slots_for(table, s, bucket)
+        from brpc_tpu.tpu.device_lane import step_dispatch
+        step_dispatch.note_launch(1)
         kpool, vpool, nxt = fn(self._params, self.kv.k_pool,
                                self.kv.v_pool, toks, slots, s)
         self.kv.update_pools(kpool, vpool)
-        return int(nxt)
+        first = int(nxt)
+        step_dispatch.note_host_sync()
+        return first
 
     def _prefill_ring(self, tokens: np.ndarray,
                       table: Sequence[int]) -> int:
@@ -259,6 +316,7 @@ class TinyTransformer:
         x = p["embed"][jnp.asarray(toks)]
         kpool, vpool = self.kv.k_pool, self.kv.v_pool
         slots = jnp.asarray(self._slots_for(table, s, pad))
+        from brpc_tpu.tpu.device_lane import step_dispatch
         for l in range(cfg.n_layers):
             h = rms(x)
             qkv = h @ p[f"wqkv{l}"]
@@ -268,55 +326,26 @@ class TinyTransformer:
             qh = q.reshape(1, pad, H, hd)
             kh = k.reshape(1, pad, H, hd)
             vh = vv.reshape(1, pad, H, hd)
+            step_dispatch.note_launch(1)
             attn = ring.ring_attention(qh, kh, vh, mesh, "sp", causal=True)
             x = x + attn.reshape(pad, -1) @ p[f"wo{l}"]
             h2 = rms(x)
             x = x + jax.nn.relu(h2 @ p[f"w1{l}"]) @ p[f"w2{l}"]
         self.kv.update_pools(kpool, vpool)
         logits = rms(x[s - 1]) @ p["embed"].T
-        return int(jnp.argmax(logits))
+        first = int(jnp.argmax(logits))
+        step_dispatch.note_host_sync()
+        return first
 
     # -------------------------------------------------------------- decode
     def _decode_fn(self, b_bucket: int, l_bucket: int):
         import jax
-        import jax.numpy as jnp
 
         cfg = self.config
-        H, hd = cfg.n_heads, cfg.head_dim
-
-        def rms(x):
-            return x * jax.lax.rsqrt(
-                jnp.mean(jnp.square(x), axis=-1, keepdims=True) + 1e-6)
 
         def impl(params, kpool, vpool, tokens, positions, slot_tables):
-            # tokens (B,), positions (B,), slot_tables (B, Lmax): flat
-            # pool slot for every context position (pads → scratch blk 0)
-            B, L = b_bucket, l_bucket
-            x = params["embed"][tokens]                       # (B, D)
-            write = slot_tables[jnp.arange(B), positions]     # (B,)
-            mask = (jnp.arange(L)[None, :]
-                    <= positions[:, None])                    # (B, L)
-            for l in range(cfg.n_layers):
-                h = rms(x)
-                qkv = h @ params[f"wqkv{l}"]
-                q, k, vv = jnp.split(qkv, 3, axis=-1)
-                kpool = kpool.at[l, write].set(k)
-                vpool = vpool.at[l, write].set(vv)
-                ks = kpool[l][slot_tables]                    # (B, L, D)
-                vs = vpool[l][slot_tables]
-                qh = q.reshape(B, H, hd)
-                kh = ks.reshape(B, L, H, hd)
-                vh = vs.reshape(B, L, H, hd)
-                s = jnp.einsum("bhd,blhd->bhl", qh, kh) / np.sqrt(hd)
-                s = jnp.where(mask[:, None, :], s, -1e30)
-                patt = jax.nn.softmax(s, axis=-1)
-                attn = jnp.einsum("bhl,blhd->bhd", patt, vh)
-                x = x + attn.reshape(B, -1) @ params[f"wo{l}"]
-                h2 = rms(x)
-                x = x + jax.nn.relu(h2 @ params[f"w1{l}"]) @ params[f"w2{l}"]
-            logits = rms(x) @ params["embed"].T               # (B, V)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return kpool, vpool, nxt
+            return _decode_body(cfg, params, kpool, vpool, tokens,
+                                positions, slot_tables, b_bucket, l_bucket)
 
         return jax.jit(impl, donate_argnums=(1, 2))
 
@@ -345,10 +374,14 @@ class TinyTransformer:
         for i, table in enumerate(tables):
             slot_tables[i] = self._slots_for(table, positions[i] + 1,
                                              l_bucket)
+        from brpc_tpu.tpu.device_lane import step_dispatch
+        step_dispatch.note_launch(1)
         kpool, vpool, nxt = fn(self._params, self.kv.k_pool,
                                self.kv.v_pool, toks, pos, slot_tables)
         self.kv.update_pools(kpool, vpool)
-        return np.asarray(nxt[:B])
+        out = np.asarray(nxt[:B])
+        step_dispatch.note_host_sync()
+        return out
 
     # ------------------------------------------------------------- helpers
     def close(self) -> None:

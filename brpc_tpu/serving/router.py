@@ -1,0 +1,154 @@
+"""Client-side sharded Generate routing over PartitionChannel.
+
+When the serving fleet runs one engine per KV shard (each server owning
+one slice of the paged pools), ``Generate`` must land on the shard that
+will own the sequence's blocks — a partitioned call, not a fan-out. This
+module rides :class:`~brpc_tpu.rpc.combo_channels.PartitionChannel`
+(partition_channel.h:46-136 semantics) with a :class:`CallMapper` that
+maps each Generate onto exactly ONE partition and ``SKIP``s the rest,
+using the same splitmix64 spread (``shard.plane.shard_for``) the
+server-side :class:`~brpc_tpu.serving.kv_cache.ShardedKVCache` uses for
+block routing — so client routing and block ownership agree by
+construction and stay stable under VersionedPool cid reuse.
+
+Failure contract: a sub-call failure during Generate is a SHARD failure,
+not a fleet failure. PartitionChannel surfaces it as ETOOMANYFAILS (the
+parallel-call verdict); :class:`ShardedLlmChannel` translates that back
+to retriable EFAILEDSOCKET so tunnel retry policies back off and retry —
+while the owning engine's reap path frees every device-local block the
+dead sequence held (tests/test_serving_mesh.py proves zero leaks under
+an armed ledger).
+
+``Stats`` stays a true fan-out: every shard reports, and
+:class:`StatsMerger` sums the per-shard gauges into one fleet view.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from brpc_tpu.proto import serving_pb2
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.channel import ChannelOptions, MethodDescriptor, RpcError
+from brpc_tpu.rpc.combo_channels import (SKIP, CallMapper, PartitionChannel,
+                                         PartitionParser, ResponseMerger,
+                                         SubCall)
+from brpc_tpu.rpc.controller import Controller
+
+GENERATE_MD = MethodDescriptor("LlmService", "Generate",
+                               serving_pb2.GenerateRequest,
+                               serving_pb2.GenerateResponse)
+STATS_MD = MethodDescriptor("LlmService", "Stats",
+                            serving_pb2.ServingStatsRequest,
+                            serving_pb2.ServingStats)
+
+
+def generate_route_key(request) -> int:
+    """Deterministic 64-bit route key for a GenerateRequest: fold the
+    prompt (or its synth length) so identical requests land on the same
+    shard and the splitmix64 avalanche in ``shard_for`` does the
+    spreading — the raw fold does NOT need to be well-distributed."""
+    key = 0xCBF29CE484222325
+    toks = list(request.prompt_tokens) or [request.prompt_len]
+    for t in toks:
+        key = ((key ^ (int(t) & 0xFFFFFFFF)) * 0x100000001B3) \
+            & 0xFFFFFFFFFFFFFFFF
+    return key
+
+
+class GenerateRouter(CallMapper):
+    """Generate -> the owning partition only; everything else fans out.
+
+    The owning partition is ``shard_for(route_key, n)`` — the SAME spread
+    the server's ShardedKVCache applies to seq ids, so a fleet whose
+    shard i serves KV shard i gets client routing consistent with block
+    ownership."""
+
+    def __init__(self, partition_count: int):
+        self.partition_count = partition_count
+
+    def map(self, channel_index: int, method: MethodDescriptor,
+            request, response) -> object:
+        if method.method_name == "Generate":
+            from brpc_tpu.shard.plane import shard_for
+
+            owner = shard_for(generate_route_key(request),
+                              self.partition_count)
+            if channel_index != owner:
+                return SKIP
+        return SubCall(method, request,
+                       method.response_class() if method.response_class
+                       else None)
+
+
+class StatsMerger(ResponseMerger):
+    """Sum per-shard ServingStats into the fleet view (proto3 MergeFrom
+    would overwrite scalars, not add them). The parallel channel runs the
+    merger on EVERY successful sub-call, Generate included — a Generate
+    has exactly one live sub-call (the owner), so anything that isn't a
+    ServingStats copies straight through."""
+
+    FIELDS = ("seqs_running", "seqs_waiting", "kv_blocks_total",
+              "kv_blocks_used", "steps", "tokens_generated")
+
+    def merge(self, response, sub_response) -> int:
+        if response is None or sub_response is None:
+            return self.MERGED
+        if not isinstance(sub_response, serving_pb2.ServingStats):
+            response.CopyFrom(sub_response)
+            return self.MERGED
+        for f in self.FIELDS:
+            setattr(response, f,
+                    getattr(response, f) + getattr(sub_response, f))
+        return self.MERGED
+
+
+class ShardedLlmChannel:
+    """Generate/Stats front door for a shard-per-server serving fleet.
+
+    Wraps a PartitionChannel whose naming tags are ``i/n`` (server i owns
+    KV shard i of n). ``fail_limit=1``: Generate issues exactly one
+    sub-call, so its first failure IS the call's failure — and it comes
+    back as EFAILEDSOCKET (retriable), never ETOOMANYFAILS, because the
+    caller should treat a dead shard like a dead connection: back off,
+    retry, land on the shard's replacement."""
+
+    def __init__(self, ns_url: str, partition_count: int,
+                 options: Optional[ChannelOptions] = None,
+                 parser: Optional[PartitionParser] = None):
+        self.partition_count = partition_count
+        self._pc = PartitionChannel(fail_limit=1)
+        self._pc.init(ns_url, partition_count, parser=parser,
+                      options=options,
+                      call_mapper=GenerateRouter(partition_count),
+                      response_merger=StatsMerger())
+
+    def shard_of(self, request) -> int:
+        from brpc_tpu.shard.plane import shard_for
+
+        return shard_for(generate_route_key(request), self.partition_count)
+
+    def generate(self, request,
+                 controller: Optional[Controller] = None,
+                 timeout_ms: Optional[float] = None):
+        cntl = controller or Controller()
+        if timeout_ms is not None:
+            cntl.timeout_ms = timeout_ms
+        try:
+            return self._pc.call_method(GENERATE_MD, request,
+                                        controller=cntl)
+        except RpcError:
+            # ONE sub-call was issued (the owner); its failure is a shard
+            # failure — retriable, the engine's reap already returned the
+            # sequence's device-local blocks
+            detail = cntl.error_text()
+            cntl.set_failed(
+                errors.EFAILEDSOCKET,
+                f"shard {self.shard_of(request)}/{self.partition_count} "
+                f"failed mid-generate (retriable): {detail}")
+            raise RpcError(cntl)
+
+    def stats(self, controller: Optional[Controller] = None):
+        return self._pc.call_method(
+            STATS_MD, serving_pb2.ServingStatsRequest(),
+            controller=controller)

@@ -32,6 +32,21 @@ except ImportError:  # older jax (0.4.x): experimental home
     from jax.experimental.shard_map import shard_map
 
 
+def shard_map_norep(f, mesh: Mesh, in_specs, out_specs):
+    """shard_map with the replication/varying-axes checker OFF — for
+    bodies that write their collectives by hand (manual psum/all_gather,
+    interpreted-Pallas kernels the checker rejects). Keeps the
+    version-fragile kwarg spelling (``check_rep`` on 0.4.x,
+    ``check_vma`` on newer jax) inside this shim module, per the
+    version-guard lint rule."""
+    try:
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+    except TypeError:  # newer jax renamed the kwarg
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
 # ------------------------------------------------------------------ wrappers
 def all_reduce(x, mesh: Mesh, axis: str):
     """Sum across the axis; every shard gets the total (ParallelChannel with

@@ -78,6 +78,31 @@ def make_mesh(axis_sizes: Dict[str, int], devices_list=None):
     return Mesh(arr, axis_names=tuple(names))
 
 
+def mesh_factors(n: int) -> Tuple[int, int, int]:
+    """Split n devices into (dp, sp, tp), preferring to use every axis —
+    the same split the multichip dryrun proves (8 -> dp=2 sp=2 tp=2).
+    Any n works: odd counts fold the even axes to 1."""
+    tp = 2 if n % 2 == 0 else 1
+    rem = n // tp
+    sp = 2 if rem % 2 == 0 else 1
+    dp = rem // sp
+    return dp, sp, tp
+
+
+def serving_mesh(devices_list=None):
+    """The serving plane's dp/sp/tp mesh over the local devices: dp shards
+    the request batch (and the KV pools), sp carries the ring-attention
+    long-context lane, tp shards attention heads in prefill. Degenerates
+    to a 1x1x1 mesh on a single chip, so the sharded serving stack is the
+    only stack — there is no separate single-device code path to drift."""
+    import jax
+
+    devs = list(devices_list if devices_list is not None
+                else jax.devices())
+    dp, sp, tp = mesh_factors(len(devs))
+    return make_mesh({"dp": dp, "sp": sp, "tp": tp}, devices_list=devs)
+
+
 def default_mesh(axis_name: str = "x"):
     """Process-wide 1-D mesh over all devices (the 'whole ring')."""
     global _default_mesh

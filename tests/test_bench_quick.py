@@ -151,11 +151,15 @@ def test_batch_phase_skips_others(batch_bench_run):
 
 @pytest.fixture(scope="module")
 def serving_bench_run():
+    # 8 virtual CPU devices so the sharded A/B runs the real dp=2/sp=2/tp=2
+    # serving mesh (matches tests/conftest.py) instead of the 1x1x1
+    # degenerate
     env = dict(os.environ,
                BENCH_QUICK="1",
                BENCH_PHASES="serving",
                BENCH_SKIP_DEVICE="1",
-               JAX_PLATFORMS="cpu")
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
     proc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
                           capture_output=True, text=True, timeout=420,
                           cwd=REPO, env=env)
@@ -165,26 +169,42 @@ def serving_bench_run():
 
 
 def test_serving_lane_json_metrics(serving_bench_run):
-    """The serving phase emits exactly its three machine-readable lines:
+    """The serving phase emits exactly its five machine-readable lines:
     streamed tokens/sec, TTFT percentiles measured at stream-frame
-    arrival, and the continuous-vs-static scheduling ratio."""
+    arrival, the continuous-vs-static scheduling ratio (sharded stack),
+    the sharded engine's tokens/sec, and the coalesced device dispatch
+    rate vs the BENCH_r05 isolated-dispatch baseline."""
     rows = [json.loads(l) for l in serving_bench_run.stdout.splitlines()
             if l.startswith("{")]
     by = {r["metric"]: r for r in rows}
     assert set(by) == {"serving_tokens_per_sec", "serving_ttft_ms",
-                       "serving_continuous_vs_static"}, \
+                       "serving_continuous_vs_static",
+                       "serving_sharded_tokens_per_s",
+                       "device_op_rate"}, \
         serving_bench_run.stdout
     assert by["serving_tokens_per_sec"]["unit"] == "tokens/s"
     assert by["serving_tokens_per_sec"]["value"] > 0
     ttft = by["serving_ttft_ms"]
     assert ttft["unit"] == "ms" and ttft["value"] > 0
     assert ttft["p99"] >= ttft["value"], ttft
+    sharded = by["serving_sharded_tokens_per_s"]
+    assert sharded["unit"] == "tokens/s" and sharded["value"] > 0, sharded
+    # the fixture forces 8 virtual devices -> the dp=2/sp=2/tp=2 mesh
+    assert sharded["devices"] == 8, sharded
+    ops = by["device_op_rate"]
+    assert ops["unit"] == "op/s" and ops["value"] > 0, ops
+    assert ops["vs_baseline"] == 7222.0, ops
+    # coalesced dispatch must beat the isolated per-RPC baseline even on
+    # the CPU sim (the fused-program path skips per-op Python dispatch)
+    assert ops["value"] > ops["vs_baseline"], ops
 
 
 def test_serving_continuous_beats_static_by_1_5x(serving_bench_run):
     """The acceptance floor: iteration-level admission must clear 1.5x the
     static-gang QPS on the mixed-length A/B (3:1 short:long, so every
-    static gang drains behind one straggler)."""
+    static gang drains behind one straggler) — with sharding on: the A/B
+    runs MeshTransformer + ShardedKVCache over the 8-virtual-device
+    mesh."""
     rows = [json.loads(l) for l in serving_bench_run.stdout.splitlines()
             if l.startswith("{")]
     ab = [r for r in rows

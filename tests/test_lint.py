@@ -17,7 +17,7 @@ EXPECTED_RULES = {
     "no-blocking-in-poller", "acquire-release", "monotonic-clock",
     "lock-order", "version-guard", "metric-flag-hygiene", "bounded-spin",
     "named-thread", "cross-process-ownership", "metric-churn",
-    "no-per-token-host-sync",
+    "no-per-token-host-sync", "no-per-op-step-dispatch",
 }
 
 
@@ -788,6 +788,105 @@ class TestNoPerTokenHostSync:
             def trace_tokens(self, seqs):
                 for s in seqs:
                     print(s.tok.item())  # tpulint: disable=no-per-token-host-sync
+            """}, rules=self.RULE)
+        assert res.clean
+        assert len(res.suppressed) == 1
+
+
+class TestNoPerOpStepDispatch:
+    RULE = ["no-per-op-step-dispatch"]
+
+    def test_store_copy_in_loop_fires(self, tmp_path):
+        res = _lint(tmp_path, {"serving/engine.py": """\
+            def stage(self, handles):
+                for h in handles:
+                    out = self.store.copy(h)
+            """}, rules=self.RULE)
+        assert [f.rule for f in res.findings] == ["no-per-op-step-dispatch"]
+        assert res.findings[0].line == 3
+        assert "coalesced" in res.findings[0].message
+
+    def test_transient_copy_in_loop_passes(self, tmp_path):
+        # transient copies enter the dispatcher's coalescing queue — the
+        # async fused path, exactly what the rule steers toward
+        res = _lint(tmp_path, {"tpu/device_stream.py": """\
+            def pump(self, handle):
+                while self.live:
+                    ok = self.store.copy(handle, transient=True)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_stub_copy_rpc_in_loop_fires(self, tmp_path):
+        res = _lint(tmp_path, {"serving/bench_lane.py": """\
+            def blast(self, stub, req):
+                for _ in range(1000):
+                    stub.Copy(req)
+            """}, rules=self.RULE)
+        assert not res.clean
+        assert "nbytes=-k" in res.findings[0].message
+
+    def test_device_put_per_item_fires(self, tmp_path):
+        res = _lint(tmp_path, {"serving/model.py": """\
+            import jax
+            def load(self, parts):
+                for p in parts:
+                    self._parts.append(jax.device_put(p))
+            """}, rules=self.RULE)
+        assert not res.clean
+        assert "transfer once" in res.findings[0].message
+
+    def test_single_dispatch_outside_loop_passes(self, tmp_path):
+        # the contract itself: build host inputs in the loop, ONE fused
+        # dispatch after it
+        res = _lint(tmp_path, {"serving/model.py": """\
+            import jax
+            import numpy as np
+            def decode_step(self, tokens, tables):
+                slot_tables = np.zeros((8, 64))
+                for i, t in enumerate(tables):
+                    slot_tables[i] = self._slots_for(t)
+                pools = jax.device_put(slot_tables)
+                return self.store.copy(self._h)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_plain_list_copy_in_loop_passes(self, tmp_path):
+        # .copy() on non-store receivers (lists, dicts, arrays) is host
+        # work, not a device dispatch
+        res = _lint(tmp_path, {"serving/engine.py": """\
+            def snapshot(self, tables):
+                out = []
+                for t in tables:
+                    out.append(t.copy())
+                return out
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_same_code_outside_scope_passes(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/replay.py": """\
+            def blast(self, stub, req):
+                for _ in range(1000):
+                    stub.Copy(req)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_dispatch_in_nested_def_not_charged_to_loop(self, tmp_path):
+        # the callback runs when fired, not per iteration of this loop —
+        # it's how the device lane's async Copy chain re-issues itself
+        res = _lint(tmp_path, {"serving/bench_lane.py": """\
+            def arm(self, stub, reqs):
+                for req in reqs:
+                    def fire(r=req):
+                        stub.Copy(r)
+                    self._cbs.append(fire)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_suppression_honored(self, tmp_path):
+        res = _lint(tmp_path, {"serving/debug.py": """\
+            def probe(self, handles):
+                for h in handles:
+                    self.store.copy(h)  # tpulint: disable=no-per-op-step-dispatch
             """}, rules=self.RULE)
         assert res.clean
         assert len(res.suppressed) == 1
