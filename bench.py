@@ -413,9 +413,12 @@ def _serving_engine_qps(scheduling: str, n_requests: int,
         kv = PagedKVCache(KVCacheConfig(block_size=16, num_blocks=256),
                           cfg.n_layers, cfg.kv_dim)
         model = TinyTransformer(cfg, kv)
+    # prefix_cache=False: this A/B isolates the SCHEDULER — cached-prefix
+    # reuse would shrink exactly the prefill work the static gang stalls
+    # behind (the prefix cache gets its own hit-TTFT lane below)
     engine = ServingEngine(model, kv, EngineConfig(
         max_batch=4, token_budget=256, scheduling=scheduling,
-        idle_wait_s=0.005)).start()
+        idle_wait_s=0.005), prefix_cache=False).start()
     tokens = sum(64 if i % 4 == 3 else 4 for i in range(n_requests))
 
     def run(n):
@@ -481,13 +484,84 @@ def _device_op_rate() -> tuple:
         store.free(handle)
 
 
+def _bench_prefix_ttft():
+    """Prefix-cache hit-TTFT A/B: two identical engines — one with the
+    radix cache disabled (cold reference), one with it on (warm) — driven
+    with a shared-prefix corpus (same synth prompt, one distinct tail
+    token per request, the system-prompt traffic shape). After the warm
+    engine's first request commits the shared chain, every later request
+    forks it and prefills ONE suffix token — hit TTFT collapses from
+    O(prompt) reference-attention prefill to one decode-shaped launch.
+    Returns (hit_ttft_ms, cold_ttft_ms, hit_ratio)."""
+    from brpc_tpu.serving import (EngineConfig, KVCacheConfig, ModelConfig,
+                                  PagedKVCache, ServingEngine,
+                                  TinyTransformer)
+
+    plen = 256 if QUICK else 512
+    reqs = 4 if QUICK else 8
+    cfg = ModelConfig(vocab=256, d_model=32, n_heads=2, n_layers=2,
+                      max_context=4 * plen)
+    ecfg = dict(max_batch=4, token_budget=4 * plen, idle_wait_s=0.002)
+
+    def build(prefix_cache):
+        kv = PagedKVCache(KVCacheConfig(block_size=16,
+                                        num_blocks=2 * (4 * plen) // 16),
+                          cfg.n_layers, cfg.kv_dim)
+        model = TinyTransformer(cfg, kv)
+        return ServingEngine(model, kv, EngineConfig(**ecfg),
+                             prefix_cache=prefix_cache).start()
+
+    base = None  # shared-prefix corpus: common first blocks, unique tail
+
+    def prompt(i):
+        p = base.copy()
+        p[-1] = 1 + (7 * i + 3) % (cfg.vocab - 1)
+        return p
+
+    def one(engine, i):
+        ev = threading.Event()
+        box = {}
+        code, _ = engine.submit(prompt(i), 4,
+                                done=lambda r, ev=ev: (box.update(r=r),
+                                                       ev.set()))
+        if code != 0:
+            raise RuntimeError(f"prefix bench submit rejected: {code}")
+        if not ev.wait(300):
+            raise RuntimeError("prefix bench stalled")
+        return box["r"].ttft_us / 1000.0
+
+    cold = build(prefix_cache=False)
+    warm = build(prefix_cache=None)
+    base = cold.model.synth_prompt(plen + 1)
+    try:
+        # warmup: compile every bucket both lanes touch (cold prefill,
+        # warm suffix decode-shape), twice for the donated-pool second
+        # jit signature; the warm engine's warmup also PRIMES the tree —
+        # the first commit is the corpus the timed hits fork
+        for _ in range(2):
+            for i in range(reqs):
+                one(cold, i)
+                one(warm, i)
+        cold_ms = _percentile(sorted(one(cold, i) for i in range(reqs)), 0.5)
+        hit_ms = _percentile(sorted(one(warm, i) for i in range(reqs)), 0.5)
+        snap = warm.snapshot()["prefix"]
+        hit_ratio = snap["hit_ratio"]
+    finally:
+        warm.stop()
+        cold.stop()
+        warm.model.close()
+        cold.model.close()
+    return hit_ms, cold_ms, hit_ratio
+
+
 def bench_serving_lane():
     """Serving plane (brpc_tpu/serving/): streamed generations over the
     RPC path against a pre-warmed child server — aggregate tokens/sec and
     TTFT percentiles measured at stream-frame arrival — then the
     in-process continuous-vs-static scheduling A/B on mixed-length
-    traffic over the SHARDED mesh stack, plus the coalesced device
-    dispatch-rate probe. Emits the five serving JSON metric lines."""
+    traffic over the SHARDED mesh stack, the prefix-cache hit-TTFT A/B,
+    plus the coalesced device dispatch-rate probe. Emits the seven
+    serving JSON metric lines."""
     from brpc_tpu.proto import serving_pb2
     from brpc_tpu.rpc import Channel, ChannelOptions, Controller, Stub
     from brpc_tpu.rpc.stream import (StreamOptions, stream_close,
@@ -567,6 +641,8 @@ def bench_serving_lane():
                                              sharded=True)
     stat_qps, _ = _serving_engine_qps("static", n_ab, sharded=True)
     ratio = cont_qps / max(stat_qps, 1e-9)
+    hit_ms, cold_ms, hit_ratio = _bench_prefix_ttft()
+    pfx_ratio = hit_ms / max(cold_ms, 1e-9)
     op_rate, n_ops = _device_op_rate()
     import jax as _jax
     n_dev = len(_jax.devices())
@@ -581,6 +657,10 @@ def bench_serving_lane():
           f"coalesced device dispatch: {n_ops} ops at {op_rate:,.0f} op/s "
           f"(isolated-dispatch baseline {BASELINE_DEVICE_OPS:,.0f})",
           file=sys.stderr)
+    print(f"# serving prefix: shared-prefix hit ttft={hit_ms:.2f}ms "
+          f"cold={cold_ms:.2f}ms ratio={pfx_ratio:.3f} "
+          f"({'OK' if pfx_ratio <= 0.5 else 'ABOVE'} 0.5x ceiling) "
+          f"hit_ratio={hit_ratio:.2f}", file=sys.stderr)
     print(json.dumps({
         "metric": "serving_tokens_per_sec",
         "value": round(tps, 1),
@@ -604,6 +684,18 @@ def bench_serving_lane():
         "value": round(cont_tps, 1),
         "unit": "tokens/s",
         "devices": n_dev,
+    }))
+    print(json.dumps({
+        "metric": "serving_prefix_hit_ttft_ms",
+        "value": round(hit_ms, 3),
+        "unit": "ms",
+        "cold_ms": round(cold_ms, 3),
+        "ratio": round(pfx_ratio, 4),
+    }))
+    print(json.dumps({
+        "metric": "serving_prefix_hit_ratio",
+        "value": round(hit_ratio, 4),
+        "unit": "ratio",
     }))
     print(json.dumps({
         "metric": "device_op_rate",

@@ -954,6 +954,79 @@ def rule_no_per_op_step_dispatch(pkg: Package) -> List[Finding]:
     return out
 
 
+# --------------------------------------------------------------------------
+# Rule 13: cow-before-write
+# --------------------------------------------------------------------------
+# The prefix cache's sharing contract (docs/serving.md): KV blocks can be
+# referenced by several sequences and by the radix tree at once, so any
+# function that commits writes into the K/V pool arrays (the
+# update_pools(...) swap is the commit point for every scatter) must
+# first prove exclusivity — an assert_writable/ensure_writable/cow_* call
+# or an explicit refcount == 1 check in the same function. A write behind
+# a shared block silently corrupts every other chain reading it; the
+# runtime guard (kv.assert_writable under BRPC_TPU_CHECK) catches it in
+# tests, this rule catches it at lint time for paths tests never arm.
+
+_COW_SCOPE_PREFIXES = ("serving/",)
+
+
+def _cow_write_sites(func: ast.AST) -> List[ast.Call]:
+    sites: List[ast.Call] = []
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            name = attr_chain(node.func)
+            if name is not None and name.split(".")[-1] == "update_pools":
+                sites.append(node)
+    return sites
+
+
+def _cow_guarded(func: ast.AST) -> bool:
+    """True when the function proves block exclusivity before writing:
+    a cow-split/writable-guard call, or a refcount == 1 comparison."""
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            name = attr_chain(node.func)
+            if name is not None:
+                last = name.split(".")[-1]
+                if "cow" in last or "writable" in last:
+                    return True
+        elif isinstance(node, ast.Compare):
+            if any(isinstance(op, ast.Eq) for op in node.ops) \
+                    and any(isinstance(c, ast.Constant) and c.value == 1
+                            for c in node.comparators) \
+                    and "ref" in ast.dump(node).lower():
+                return True
+    return False
+
+
+@register_rule(
+    "cow-before-write",
+    "serving/ functions that write into the KV pool arrays (the "
+    "update_pools commit) must cow-split or assert refcount==1 first — "
+    "shared prefix blocks are never mutated in place")
+def rule_cow_before_write(pkg: Package) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in pkg.files:
+        if not in_scope(sf.rel, prefixes=_COW_SCOPE_PREFIXES):
+            continue
+        for func, cls in iter_functions(sf.tree):
+            if "cow" in func.name or "writable" in func.name:
+                continue  # the split/guard implementations themselves
+            sites = _cow_write_sites(func)
+            if not sites or _cow_guarded(func):
+                continue
+            where = f"{cls}.{func.name}" if cls else func.name
+            for call in sites:
+                out.append(Finding(
+                    "cow-before-write", sf.rel, call.lineno,
+                    f"{where}() commits a KV pool write (update_pools) "
+                    f"with no cow-split or refcount==1 guard in scope — "
+                    f"a shared prefix block would be mutated in place; "
+                    f"call kv.assert_writable/ensure_writable (or "
+                    f"cow_block) before the scatter"))
+    return out
+
+
 @register_rule(
     "metric-churn",
     "no metric construction (Adder/LatencyRecorder/Window/...) or expose() "

@@ -668,6 +668,16 @@ def serving_service(server, http: HttpMessage):
                    f"watermark={kv['watermark']:.0%}, "
                    f"block_size={kv['block_size']}, "
                    f"sequences={kv['sequences']}")
+        pfx = s.get("prefix")
+        if pfx:
+            out.append(
+                f"  prefix: nodes={pfx['nodes']} blocks={pfx['blocks']} "
+                f"hits seqs={pfx['hit_seqs']} blocks={pfx['hit_blocks']} "
+                f"tokens={pfx['hit_tokens']} "
+                f"inserted={pfx['inserted_blocks']} "
+                f"evicted={pfx['evicted_blocks']} "
+                f"hit_ratio={pfx['hit_ratio']:.2f}"
+                + ("" if pfx.get("enabled", True) else " (disabled)"))
         # sharded pools: per-device occupancy, per-shard step latency,
         # and which shard owns each live sequence's block table
         if "shards" in kv:

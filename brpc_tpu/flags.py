@@ -271,3 +271,23 @@ serving_shard_skew_ratio = define(
     "exceeds its fleet mean by more than this ratio (reloadable: the "
     "rule reads the flag at every tick)",
     validator=lambda v: 0.0 < v <= 1.0)
+serving_prefix_cache_enabled = define(
+    "serving_prefix_cache_enabled", True,
+    "radix prefix cache over the paged KV pools: admission forks the "
+    "longest cached block-aligned prefix chain (refcount++, zero "
+    "copies) and prefills only the suffix; completion commits full "
+    "blocks back into the tree (reloadable: the engine reads the flag "
+    "per admission)", validator=lambda v: v in (True, False, 0, 1))
+serving_prefix_evict_watermark = define(
+    "serving_prefix_evict_watermark", 0.80,
+    "prefix-cache trim target: tree commits evict LRU refcount-1 "
+    "chains until pool occupancy is back under this ratio, keeping the "
+    "slack up to the admission watermark as decode headroom "
+    "(reloadable: read at every trim)",
+    validator=lambda v: 0.0 < float(v) <= 1.0)
+serving_prefix_thrash_rate = define(
+    "serving_prefix_thrash_rate", 20.0,
+    "serving_prefix_thrash watch rule fires when prefix-cache eviction "
+    "sustains above this many blocks/s — the tree is churning instead "
+    "of caching (reloadable: the rule reads the flag at every tick)",
+    validator=_positive)

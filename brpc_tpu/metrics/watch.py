@@ -280,3 +280,11 @@ def install_default_rules() -> None:
         "serving_shard_skew", "g_serving_kv_shard_skew",
         KIND_THRESHOLD, ">", 0.25, window_s=10, for_ticks=2, clear_ticks=5,
         value_fn=lambda: _flags.get("serving_shard_skew_ratio")))
+    # prefix cache: sustained eviction means the radix tree is thrashing —
+    # the working set of prefixes outruns the pool's cache headroom, so
+    # chains are evicted before they can be re-hit. Bound is the
+    # reloadable serving_prefix_thrash_rate flag (blocks/s)
+    w.add(WatchRule(
+        "serving_prefix_thrash", "g_serving_prefix_evicted_blocks",
+        KIND_RATE, ">", 20, window_s=10, for_ticks=2, clear_ticks=5,
+        value_fn=lambda: _flags.get("serving_prefix_thrash_rate")))

@@ -22,14 +22,23 @@ mechanism:
   axis, shard_map prefill/decode that keeps each engine step at ONE
   fused launch + ONE host sync across the whole mesh.
 - :mod:`brpc_tpu.serving.router` — client-side shard routing: Generate
-  lands on the owning partition through PartitionChannel; shard failures
-  come back retriable (EFAILEDSOCKET).
+  lands on the owning partition through PartitionChannel (prefix-hash
+  routed when the fleet runs the prefix cache); shard failures come back
+  retriable (EFAILEDSOCKET).
+- :mod:`brpc_tpu.serving.prefix_cache` — radix tree over token prefixes
+  mapping to refcounted KV block chains: admission forks the longest
+  cached prefix (zero copies), completion commits blocks back
+  (insert-or-share), eviction is watermark-aware LRU over refcount-1
+  chains.
 """
 
 from brpc_tpu.serving.kv_cache import (KVCacheConfig, PagedKVCache,
                                        ShardedKVCache, ShardTable)
 from brpc_tpu.serving.model import ModelConfig, TinyTransformer
 from brpc_tpu.serving.engine import EngineConfig, ServingEngine, active_engines
+from brpc_tpu.serving.prefix_cache import (PrefixCache, ShardedPrefixCache,
+                                           build_prefix_cache,
+                                           prefix_route_key)
 from brpc_tpu.serving.service import LlmServingService
 
 
@@ -50,5 +59,7 @@ __all__ = [
     "KVCacheConfig", "PagedKVCache", "ShardedKVCache", "ShardTable",
     "ModelConfig", "TinyTransformer", "MeshTransformer",
     "EngineConfig", "ServingEngine", "active_engines",
+    "PrefixCache", "ShardedPrefixCache", "build_prefix_cache",
+    "prefix_route_key",
     "LlmServingService", "ShardedLlmChannel",
 ]

@@ -142,6 +142,9 @@ class Sequence:
         self.stream_id = stream_id
         self.state = STATE_WAITING
         self.out_tokens: List[int] = []
+        # tokens covered by a forked prefix-cache chain (block-aligned);
+        # prefill runs only the suffix past this point
+        self.prefix_len = 0
         self.t_submit = time.monotonic()
         self.t_first_token = 0.0
         self.t_last_token = 0.0
@@ -158,10 +161,17 @@ class Sequence:
 
 class ServingEngine:
     def __init__(self, model: TinyTransformer, kv: Optional[PagedKVCache] = None,
-                 config: Optional[EngineConfig] = None):
+                 config: Optional[EngineConfig] = None, prefix_cache=None):
         self.model = model
         self.kv = kv if kv is not None else model.kv
         self.config = config or EngineConfig()
+        # radix prefix cache: None auto-builds over the pool (gated per
+        # admission by the serving_prefix_cache_enabled flag), False
+        # disables outright (cold A/B lanes, oracle reference engines)
+        if prefix_cache is None and hasattr(model, "prefill_suffix"):
+            from brpc_tpu.serving.prefix_cache import build_prefix_cache
+            prefix_cache = build_prefix_cache(self.kv)
+        self.prefix = prefix_cache or None
         self._cv = threading.Condition()
         self._waiting: Deque[Sequence] = collections.deque()
         self._running: List[Sequence] = []
@@ -200,6 +210,9 @@ class ServingEngine:
         # fan a retriable error to anything still in flight, then prove
         # the pool whole — the CreditLedger teardown discipline
         self._abort_all_locked_out(abort_code, "engine stopped")
+        if self.prefix is not None:
+            # release every tree hold so assert_idle sees the pool whole
+            self.prefix.clear()
         with _engines_lock:
             if self in _engines:
                 _engines.remove(self)
@@ -242,11 +255,29 @@ class ServingEngine:
             seq = Sequence(prompt, max_new_tokens, stop_token, cntl, done,
                            stream_id)
             queued = sum(s.context_len() for s in self._waiting)
-            if not self.kv.can_admit(queued + len(prompt),
-                                     route_key=seq.seq_id):
-                self.kv.note_rejected()
-                g_serving_rejected.put(1)
-                return errors.EOVERCROWDED, None
+            need = queued + len(prompt)
+            shard = None
+            if self.prefix is not None:
+                # a cached prefix's blocks are already counted in pool
+                # occupancy — only the suffix is new demand; prefix-hash
+                # placement beats the seq-id route so the hit lands on
+                # the shard holding the chain
+                shard = self.prefix.route_shard(prompt)
+                need = queued + max(1, len(prompt)
+                                    - self.prefix.match_len(prompt))
+            if not self.kv.can_admit(need, route_key=seq.seq_id,
+                                     shard=shard):
+                # before rejecting, ask the tree to give back LRU
+                # refcount-1 chains — EOVERCROWDED semantics unchanged,
+                # the watermark just sees fewer cache-held blocks
+                if not (self.prefix is not None
+                        and self.prefix.evict_for_admission(
+                            need, shard=shard, route_key=seq.seq_id)
+                        and self.kv.can_admit(need, route_key=seq.seq_id,
+                                              shard=shard)):
+                    self.kv.note_rejected()
+                    g_serving_rejected.put(1)
+                    return errors.EOVERCROWDED, None
             self._waiting.append(seq)
             self._cv.notify()
         return 0, seq
@@ -296,7 +327,7 @@ class ServingEngine:
         admitted: List[Sequence] = []
         budget = cfg.token_budget - len(self._running)
         while (self._waiting and len(self._running) < cfg.max_batch
-               and budget >= len(self._waiting[0].prompt)):
+               and budget >= self._prefill_cost(self._waiting[0])):
             seq = self._waiting[0]
             deadline = (getattr(seq.cntl, "deadline_mono", 0.0)
                         if seq.cntl else 0.0)
@@ -307,16 +338,62 @@ class ServingEngine:
                              "deadline expired in serving queue")
                 continue
             try:
-                self.kv.alloc_sequence(seq.seq_id, seq.context_len())
+                self._alloc_for(seq)
             except KVCacheFull:
-                break  # keep FIFO order; retry next step
+                # one retry after asking the tree for its LRU refcount-1
+                # chains; still full means genuinely out of headroom
+                if not (self.prefix is not None
+                        and self.prefix.evict_for_admission(
+                            seq.context_len(), route_key=seq.seq_id)):
+                    break  # keep FIFO order; retry next step
+                try:
+                    self._alloc_for(seq)
+                except KVCacheFull:
+                    break
             self._waiting.popleft()
-            budget -= len(seq.prompt)
+            budget -= self._prefill_cost(seq)
             seq.state = STATE_RUNNING
             self._running.append(seq)
             admitted.append(seq)
             g_serving_admitted.put(1)
         return admitted
+
+    def _prefill_cost(self, seq: Sequence) -> int:
+        """Iteration-budget cost of prefilling ``seq``: only the suffix
+        past the cached prefix runs through the model (≥ 1 — the first
+        token is always sampled by this engine)."""
+        if self.prefix is None:
+            return len(seq.prompt)
+        if seq.prefix_len:  # already forked (allocated, not yet stepped)
+            return max(1, len(seq.prompt) - seq.prefix_len)
+        return max(1, len(seq.prompt) - self.prefix.match_len(seq.prompt))
+
+    def _alloc_for(self, seq: Sequence) -> None:
+        """Allocate ``seq``'s block table — forking the longest cached
+        prefix chain when the radix tree has one (refcount++, zero
+        copies), falling back to a cold allocation (prefix-hash placed
+        on the sharded pool, so a first-seen prefix builds its chain on
+        the shard later hits will route to)."""
+        if self.prefix is None:
+            self.kv.alloc_sequence(seq.seq_id, seq.context_len())
+            return
+        matched = self.prefix.fork(seq.seq_id, seq.prompt)
+        if matched:
+            seq.prefix_len = matched
+            try:
+                # grow the adopted chain to cover prompt + decode slot
+                self.kv.extend_sequence(seq.seq_id, seq.context_len())
+            except KVCacheFull:
+                self.kv.free_sequence(seq.seq_id)  # unwind the fork
+                seq.prefix_len = 0
+                raise
+            return
+        shard = self.prefix.route_shard(seq.prompt)
+        if shard is not None:
+            self.kv.alloc_sequence(seq.seq_id, seq.context_len(),
+                                   shard=shard)
+        else:
+            self.kv.alloc_sequence(seq.seq_id, seq.context_len())
 
     def _step(self, admitted: List[Sequence]) -> None:
         t0 = time.perf_counter_ns()
@@ -326,10 +403,21 @@ class ServingEngine:
             try:
                 for seq in admitted:
                     tp0 = time.perf_counter_ns()
-                    table = self.kv.block_table(seq.seq_id)
-                    first = self.model.prefill(seq.prompt, table)
+                    if seq.prefix_len:
+                        # forked chain: cow-split the divergence block if
+                        # shared, then run only the suffix — hit TTFT is
+                        # one decode-shaped launch, not O(prompt) prefill
+                        self.kv.ensure_writable(seq.seq_id, seq.prefix_len)
+                        table = self.kv.block_table(seq.seq_id)
+                        first = self.model.prefill_suffix(
+                            seq.prompt, table, seq.prefix_len)
+                        g_serving_prefill_tokens.put(
+                            len(seq.prompt) - seq.prefix_len)
+                    else:
+                        table = self.kv.block_table(seq.seq_id)
+                        first = self.model.prefill(seq.prompt, table)
+                        g_serving_prefill_tokens.put(len(seq.prompt))
                     self._append_token(seq, first)
-                    g_serving_prefill_tokens.put(len(seq.prompt))
                     span = getattr(seq.cntl, "span", None)
                     if span is not None:
                         span.add_phase(
@@ -456,6 +544,13 @@ class ServingEngine:
         self._running = still
 
     def _finish(self, seq: Sequence, code: int, reason: str) -> None:
+        if code == 0 and self.prefix is not None and seq.out_tokens:
+            # commit the fully-written blocks back into the radix tree
+            # (insert-or-share) before the table drops; the last sampled
+            # token's K/V was never written, hence the -1 valid length
+            self.prefix.commit(
+                seq.seq_id, list(seq.prompt) + seq.out_tokens,
+                len(seq.prompt) + len(seq.out_tokens) - 1)
         self.kv.free_sequence(seq.seq_id)
         if seq.state != STATE_DONE:
             seq.state = STATE_DONE
@@ -526,4 +621,6 @@ class ServingEngine:
                 for sh, st in sorted(self._shard_step.items())
             },
             "kv": kv,
+            "prefix": (self.prefix.snapshot()
+                       if self.prefix is not None else None),
         }

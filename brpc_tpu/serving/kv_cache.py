@@ -124,6 +124,12 @@ class PagedKVCache:
         self._ref: Dict[int, int] = {}
         self._tables: Dict[int, List[int]] = {}
         self._seq_len: Dict[int, int] = {}
+        # blocks held by the prefix cache's radix tree (no table): each
+        # hold contributes to _ref, audited as cache-held, not table-held
+        self._cache_ref: Dict[int, int] = {}
+        # sharded pools are ledger-only; their owner installs the device
+        # copy used by cow_block against the stacked per-mesh pools
+        self._cow_copy_fn = None
         self._check = False
         try:
             from brpc_tpu.analysis import runtime_check
@@ -162,10 +168,13 @@ class PagedKVCache:
         return max(1, (ntokens + bs - 1) // bs)
 
     # ------------------------------------------------------------ admission
-    def can_admit(self, ntokens: int, route_key: Optional[int] = None) -> bool:
+    def can_admit(self, ntokens: int, route_key: Optional[int] = None,
+                  shard: Optional[int] = None) -> bool:
         """Watermark admission: the pool after this sequence's prefill
         blocks must stay at or under ``watermark`` of capacity, leaving
-        the slack as decode headroom for sequences already running."""
+        the slack as decode headroom for sequences already running.
+        (``shard`` is accepted for interface parity with the sharded
+        cache; a single pool has nowhere else to route.)"""
         need = self.blocks_for(ntokens)
         limit = int(self.config.watermark * self.config.num_blocks)
         with self._lock:
@@ -238,6 +247,149 @@ class PagedKVCache:
             self._audit_locked()
         return list(self._tables[dst_seq])
 
+    def adopt_sequence(self, seq_id: int, blocks: List[int],
+                       ntokens: int) -> List[int]:
+        """Register a new sequence whose table IS an existing block chain
+        (a prefix-cache hit): refcount++ on every chain block, zero
+        allocations, zero copies. The chain must be live (held by the
+        radix tree and/or other sequences) and must cover ``ntokens``."""
+        bs = self.config.block_size
+        if ntokens > len(blocks) * bs:
+            raise ValueError(f"chain of {len(blocks)} blocks cannot cover "
+                             f"{ntokens} tokens (block_size {bs})")
+        with self._lock:
+            if seq_id in self._tables:
+                raise ValueError(f"sequence {seq_id} already has a table")
+            for b in blocks:
+                if b not in self._ref:
+                    raise KeyError(f"block {b} is not live")
+            for b in blocks:
+                self._ref[b] += 1
+            self._tables[seq_id] = list(blocks)
+            self._seq_len[seq_id] = ntokens
+            self._audit_locked()
+        return list(blocks)
+
+    def retain_block(self, block: int) -> None:
+        """Take a prefix-cache hold on a live block (radix-tree commit):
+        the block survives free_sequence until release_block drops the
+        hold. Cache holds are audited separately from table holds."""
+        with self._lock:
+            if block not in self._ref:
+                raise KeyError(f"block {block} is not live")
+            self._ref[block] += 1
+            self._cache_ref[block] = self._cache_ref.get(block, 0) + 1
+            self._audit_locked()
+
+    def release_block(self, block: int) -> int:
+        """Drop a prefix-cache hold (eviction / tree clear); the block
+        returns to the free list when its refcount hits zero. Returns
+        blocks actually freed (0 or 1)."""
+        freed = 0
+        with self._lock:
+            held = self._cache_ref.get(block, 0)
+            if held < 1:
+                raise KeyError(f"block {block} has no cache hold")
+            if held == 1:
+                del self._cache_ref[block]
+            else:
+                self._cache_ref[block] = held - 1
+            self._ref[block] -= 1
+            if self._ref[block] == 0:
+                del self._ref[block]
+                self._free.append(block)
+                freed = 1
+            self._audit_locked()
+        if freed:
+            g_serving_kv_block_frees.put(freed)
+        return freed
+
+    def block_ref(self, block: int) -> int:
+        with self._lock:
+            return self._ref.get(block, 0)
+
+    def cache_held_blocks(self) -> int:
+        """Distinct blocks currently pinned by prefix-cache holds."""
+        with self._lock:
+            return len(self._cache_ref)
+
+    # -------------------------------------------------------- copy-on-write
+    def cow_block(self, seq_id: int, block_index: int) -> int:
+        """Copy-on-write split: make ``table[block_index]`` exclusively
+        owned by ``seq_id`` before a write lands in it. Exclusive blocks
+        (refcount == 1) pass through untouched; shared ones get a fresh
+        block, a device-side page copy, and the table entry swapped —
+        the writer never mutates a block another chain can still read."""
+        with self._lock:
+            table = self._tables.get(seq_id)
+            if table is None:
+                raise KeyError(f"unknown sequence {seq_id}")
+            if not 0 <= block_index < len(table):
+                raise IndexError(f"block index {block_index} outside "
+                                 f"table of {len(table)}")
+            src = table[block_index]
+            if self._ref.get(src, 0) == 1:
+                return src  # exclusive already — no split needed
+            dst = self._take_block_locked()
+        g_serving_kv_block_allocs.put(1)
+        # device page copy OUTSIDE the ledger lock (it dispatches); the
+        # source stays refcounted by this sequence until the swap below
+        copy = self._cow_copy_fn or self._cow_copy_block_device
+        copy(dst, src)
+        with self._lock:
+            table[block_index] = dst
+            self._ref[src] -= 1
+            if self._ref[src] == 0:
+                del self._ref[src]
+                self._free.append(src)
+            self._audit_locked()
+        return dst
+
+    def ensure_writable(self, seq_id: int, pos: int) -> int:
+        """COW front door for the engine: split the block that the write
+        at token position ``pos`` lands in, if shared. Returns the
+        (possibly fresh) physical block id."""
+        return self.cow_block(seq_id, pos // self.config.block_size)
+
+    def _cow_copy_block_device(self, dst: int, src: int) -> None:
+        if self.k_pool is None:
+            return  # ledger-only pool with no cow hook installed
+        bs = self.config.block_size
+        d0, s0 = dst * bs, src * bs
+        k = self.k_pool.at[:, d0:d0 + bs, :].set(
+            self.k_pool[:, s0:s0 + bs, :])
+        v = self.v_pool.at[:, d0:d0 + bs, :].set(
+            self.v_pool[:, s0:s0 + bs, :])
+        self.update_pools(k, v)
+
+    def assert_writable(self, table, start: int, stop: int) -> None:
+        """COW-contract guard (armed ledger only): every block the write
+        range ``[start, stop)`` lands in must be exclusively owned —
+        refcount 1 — else a shared (forked or tree-held) page would be
+        silently clobbered. The serving model calls this before every
+        pool-scattering launch; tpulint's cow-before-write rule keeps
+        future write sites doing the same."""
+        if not self._check or stop <= start:
+            return
+        bs = self.config.block_size
+        with self._lock:
+            for bi in range(start // bs, (stop - 1) // bs + 1):
+                b = table[bi]
+                ref = self._ref.get(b, 0)
+                if ref != 1:
+                    raise AssertionError(
+                        f"cow violation: write in [{start},{stop}) hits "
+                        f"block {b} (table[{bi}]) with refcount {ref}; "
+                        f"shared blocks must be cow-split before writing")
+
+    def assert_writable_batch(self, tables, positions) -> None:
+        """Per-row COW guard for a decode batch: row i writes exactly at
+        ``positions[i]`` in ``tables[i]``."""
+        if not self._check:
+            return
+        for t, p in zip(tables, positions):
+            self.assert_writable(t, int(p), int(p) + 1)
+
     def free_sequence(self, seq_id: int) -> int:
         """Drop a sequence's table; blocks return to the free list when
         their refcount hits zero. Returns blocks actually freed."""
@@ -296,6 +448,8 @@ class PagedKVCache:
         for seq, table in self._tables.items():
             for b in table:
                 held[b] = held.get(b, 0) + 1
+        for b, n in self._cache_ref.items():
+            held[b] = held.get(b, 0) + n
         if held != self._ref:
             problems.append(
                 f"refcounts {self._ref} disagree with tables {held}")
@@ -320,6 +474,10 @@ class PagedKVCache:
                 problems.append(
                     f"{len(self._tables)} sequence table(s) still live: "
                     f"{sorted(self._tables)}")
+            if self._cache_ref:
+                problems.append(
+                    f"{len(self._cache_ref)} block hold(s) still owned by "
+                    f"the prefix cache: {sorted(self._cache_ref)}")
             if len(self._free) != self.config.num_blocks:
                 problems.append(
                     f"{self.config.num_blocks - len(self._free)} "
@@ -345,6 +503,7 @@ class PagedKVCache:
                 "watermark": self.config.watermark,
                 "used_ratio": used / float(self.config.num_blocks),
                 "sequences": len(self._tables),
+                "blocks_cached": len(self._cache_ref),
             }
 
 
@@ -415,6 +574,10 @@ class ShardedKVCache:
         self.pools = [PagedKVCache(config, layers, kv_dim,
                                    device_pools=False)
                       for _ in range(self.n_shards)]
+        for i, p in enumerate(self.pools):
+            # ledger-only shards cow-copy through the stacked mesh pools
+            p._cow_copy_fn = (lambda dst, src, _s=i:
+                              self._cow_copy_block_shard(_s, dst, src))
         self._shard_of: Dict[int, int] = {}
         slots = (config.num_blocks + 1) * config.block_size
         dtype = dtype or jnp.float32
@@ -482,9 +645,14 @@ class ShardedKVCache:
         return shard, self.pools[shard]
 
     # ------------------------------------------------------------ admission
-    def can_admit(self, ntokens: int, route_key: Optional[int] = None) -> bool:
+    def can_admit(self, ntokens: int, route_key: Optional[int] = None,
+                  shard: Optional[int] = None) -> bool:
         """Watermark admission against the OWNING shard's pool when the
-        routing key is known; against the fleet aggregate otherwise."""
+        placement is known — an explicit ``shard`` (prefix-hash routing)
+        beats the ``route_key`` hash — and against the fleet aggregate
+        otherwise."""
+        if shard is not None:
+            return self.pools[shard].can_admit(ntokens)
         if route_key is not None:
             return self.pools[self.shard_of(route_key)].can_admit(ntokens)
         need = self.blocks_for(ntokens)
@@ -495,12 +663,23 @@ class ShardedKVCache:
         g_serving_kv_admission_rejects.put(1)
 
     # ----------------------------------------------------------- block ops
-    def alloc_sequence(self, seq_id: int, ntokens: int) -> ShardTable:
-        shard = self.shard_of(seq_id)
+    def alloc_sequence(self, seq_id: int, ntokens: int,
+                       shard: Optional[int] = None) -> ShardTable:
+        if shard is None:
+            shard = self.shard_of(seq_id)
         table = self.pools[shard].alloc_sequence(seq_id, ntokens)
         with self._lock:
             self._shard_of[seq_id] = shard
         return ShardTable(shard, table)
+
+    def pin_shard(self, seq_id: int, shard: int) -> None:
+        """Pin a sequence to a shard ahead of ledger registration — the
+        prefix cache pins hits to the shard whose tree holds the chain,
+        overriding the splitmix64 route."""
+        if not 0 <= shard < self.n_shards:
+            raise ValueError(f"shard {shard} outside [0, {self.n_shards})")
+        with self._lock:
+            self._shard_of[seq_id] = shard
 
     def extend_sequence(self, seq_id: int, new_len: int) -> ShardTable:
         got = self._pool_of(seq_id)
@@ -528,6 +707,37 @@ class ShardedKVCache:
         if shard is None:
             return 0
         return self.pools[shard].free_sequence(seq_id)
+
+    # -------------------------------------------------------- copy-on-write
+    def cow_block(self, seq_id: int, block_index: int) -> int:
+        got = self._pool_of(seq_id)
+        if got is None:
+            raise KeyError(f"unknown sequence {seq_id}")
+        return got[1].cow_block(seq_id, block_index)
+
+    def ensure_writable(self, seq_id: int, pos: int) -> int:
+        return self.cow_block(seq_id, pos // self.config.block_size)
+
+    def _cow_copy_block_shard(self, shard: int, dst: int, src: int) -> None:
+        """Device page copy for a ledger-only shard pool, against the
+        stacked per-mesh arrays (one functional update, one swap)."""
+        bs = self.config.block_size
+        d0, s0 = dst * bs, src * bs
+        k = self.k_pools.at[shard, :, d0:d0 + bs, :].set(
+            self.k_pools[shard, :, s0:s0 + bs, :])
+        v = self.v_pools.at[shard, :, d0:d0 + bs, :].set(
+            self.v_pools[shard, :, s0:s0 + bs, :])
+        self.update_pools(k, v)
+
+    def assert_writable(self, table, start: int, stop: int) -> None:
+        self.pools[getattr(table, "shard", 0)].assert_writable(
+            table, start, stop)
+
+    def assert_writable_batch(self, tables, positions) -> None:
+        if not self._check:
+            return
+        for t, p in zip(tables, positions):
+            self.assert_writable(t, int(p), int(p) + 1)
 
     def block_table(self, seq_id: int) -> Optional[ShardTable]:
         got = self._pool_of(seq_id)
@@ -593,6 +803,7 @@ class ShardedKVCache:
             "watermark": self.config.watermark,
             "used_ratio": used / float(total),
             "sequences": sum(s["sequences"] for s in shards),
+            "blocks_cached": sum(s["blocks_cached"] for s in shards),
             "n_shards": self.n_shards,
             "shard_skew": max(ratios) - sum(ratios) / len(ratios),
             "shards": shards,

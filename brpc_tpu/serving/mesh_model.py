@@ -123,6 +123,7 @@ class MeshTransformer(TinyTransformer):
         s = len(tokens)
         if s >= cfg.ring_threshold:
             return self._prefill_ring(tokens, table)
+        self.kv.assert_writable(table, 0, s)
         shard = getattr(table, "shard", 0)
         bucket = max(16, _next_pow2(s))
         if bucket > 128:
@@ -163,6 +164,7 @@ class MeshTransformer(TinyTransformer):
         shard = int(getattr(table, "shard", 0))
         n = int(self.mesh.shape["sp"])
         s = len(tokens)
+        self.kv.assert_writable(table, 0, s)
         pad = ((s + n - 1) // n) * n
         p = self._params
         toks = np.zeros(pad, dtype=np.int32)
@@ -222,6 +224,7 @@ class MeshTransformer(TinyTransformer):
         one shard_map program, one host materialization."""
         bs = self.kv.block_size
         B = len(tokens)
+        self.kv.assert_writable_batch(tables, positions)
         dp = self.dp
         groups: List[List[int]] = [[] for _ in range(dp)]
         for i, t in enumerate(tables):

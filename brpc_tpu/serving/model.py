@@ -263,6 +263,7 @@ class TinyTransformer:
         s = len(tokens)
         if s >= cfg.ring_threshold:
             return self._prefill_ring(tokens, table)
+        self.kv.assert_writable(table, 0, s)
         bucket = max(16, _next_pow2(s))
         if bucket > 128:
             bucket = ((s + 127) // 128) * 128  # flash wants S % 128 == 0
@@ -285,6 +286,24 @@ class TinyTransformer:
         step_dispatch.note_host_sync()
         return first
 
+    def prefill_suffix(self, tokens: np.ndarray, table: Sequence[int],
+                       start: int) -> int:
+        """Prefill only ``tokens[start:]`` against a table whose first
+        ``start`` positions already hold committed K/V (a forked prefix
+        chain). Runs through the SAME fused decode program as steady-state
+        decode — one row per suffix token, each gathering the full paged
+        context — so a cache hit costs one decode-shaped launch and the
+        written K/V (and the sampled token, row ``s - 1``'s argmax) are
+        bit-identical to what cold prefill produces. Inherits to the mesh
+        model unchanged: decode_step places rows by ``table.shard``."""
+        s = len(tokens)
+        if not 0 < start < s:
+            raise ValueError(f"suffix start {start} outside (0, {s})")
+        suffix = np.asarray(tokens[start:], dtype=np.int32)
+        positions = np.arange(start, s, dtype=np.int32)
+        out = self.decode_step(suffix, positions, [table] * (s - start))
+        return int(out[-1])
+
     def _prefill_ring(self, tokens: np.ndarray,
                       table: Sequence[int]) -> int:
         """Long-context prefill: per-layer attention through the ring
@@ -304,6 +323,7 @@ class TinyTransformer:
             else default_mesh("sp")
         n = mesh.shape["sp"]
         s = len(tokens)
+        self.kv.assert_writable(table, 0, s)
         pad = ((s + n - 1) // n) * n
         p = self._params
 
@@ -357,6 +377,7 @@ class TinyTransformer:
         not per token)."""
         bs = self.kv.block_size
         B = len(tokens)
+        self.kv.assert_writable_batch(tables, positions)
         b_bucket = max(2, _next_pow2(B))
         max_blocks = max(len(t) for t in tables)
         l_bucket = max(2, _next_pow2(max_blocks)) * bs

@@ -56,23 +56,45 @@ def generate_route_key(request) -> int:
     return key
 
 
+def _request_route_key(request, block_size: int) -> int:
+    """Prefix-hash route key: fold only the first cached-block-aligned
+    window of the prompt (``prefix_route_key``) so every request sharing
+    a cacheable first block routes to the shard whose radix tree holds
+    the chain — the SAME placement the server's ShardedPrefixCache
+    computes. Falls back to ``generate_route_key`` (whole-prompt fold)
+    when the prompt cannot hit the cache."""
+    from brpc_tpu.serving.prefix_cache import prefix_route_key
+
+    toks = list(request.prompt_tokens)
+    key = prefix_route_key(toks, block_size) if toks else None
+    return key if key is not None else generate_route_key(request)
+
+
 class GenerateRouter(CallMapper):
     """Generate -> the owning partition only; everything else fans out.
 
     The owning partition is ``shard_for(route_key, n)`` — the SAME spread
     the server's ShardedKVCache applies to seq ids, so a fleet whose
     shard i serves KV shard i gets client routing consistent with block
-    ownership."""
+    ownership. With ``block_size`` set, the route key is the prefix hash
+    (first cached block of token ids) so same-prefix traffic lands on the
+    shard that holds the cached chain."""
 
-    def __init__(self, partition_count: int):
+    def __init__(self, partition_count: int, block_size: int = 0):
         self.partition_count = partition_count
+        self.block_size = block_size
+
+    def route_key(self, request) -> int:
+        if self.block_size:
+            return _request_route_key(request, self.block_size)
+        return generate_route_key(request)
 
     def map(self, channel_index: int, method: MethodDescriptor,
             request, response) -> object:
         if method.method_name == "Generate":
             from brpc_tpu.shard.plane import shard_for
 
-            owner = shard_for(generate_route_key(request),
+            owner = shard_for(self.route_key(request),
                               self.partition_count)
             if channel_index != owner:
                 return SKIP
@@ -115,18 +137,22 @@ class ShardedLlmChannel:
 
     def __init__(self, ns_url: str, partition_count: int,
                  options: Optional[ChannelOptions] = None,
-                 parser: Optional[PartitionParser] = None):
+                 parser: Optional[PartitionParser] = None,
+                 block_size: int = 0):
         self.partition_count = partition_count
+        self._router = GenerateRouter(partition_count,
+                                      block_size=block_size)
         self._pc = PartitionChannel(fail_limit=1)
         self._pc.init(ns_url, partition_count, parser=parser,
                       options=options,
-                      call_mapper=GenerateRouter(partition_count),
+                      call_mapper=self._router,
                       response_merger=StatsMerger())
 
     def shard_of(self, request) -> int:
         from brpc_tpu.shard.plane import shard_for
 
-        return shard_for(generate_route_key(request), self.partition_count)
+        return shard_for(self._router.route_key(request),
+                         self.partition_count)
 
     def generate(self, request,
                  controller: Optional[Controller] = None,

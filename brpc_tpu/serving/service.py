@@ -12,6 +12,15 @@ Requests carrying stream settings take the server's full dispatch path
 (the slim/fast lanes only accept requests without them), which is also
 what stamps ``cntl.deadline_mono`` for the engine's admission re-check and
 carries the span the engine annotates with prefill/decode phases.
+
+With the radix prefix cache enabled the engine's admission path matches
+the prompt (``prompt_tokens``, or the deterministic ``synth_prompt``
+expansion of ``prompt_len``) against cached block chains — repeated
+prompts fork the chain and prefill only the suffix, bit-identical to a
+cold run by the greedy-decode contract. On a sharded fleet the client's
+:class:`~brpc_tpu.serving.router.ShardedLlmChannel` prefix-hash routes
+the request to the shard whose tree holds the chain; this service never
+needs to know — placement agreement is in the route key.
 """
 
 from __future__ import annotations

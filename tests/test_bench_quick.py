@@ -169,17 +169,20 @@ def serving_bench_run():
 
 
 def test_serving_lane_json_metrics(serving_bench_run):
-    """The serving phase emits exactly its five machine-readable lines:
+    """The serving phase emits exactly its seven machine-readable lines:
     streamed tokens/sec, TTFT percentiles measured at stream-frame
     arrival, the continuous-vs-static scheduling ratio (sharded stack),
-    the sharded engine's tokens/sec, and the coalesced device dispatch
-    rate vs the BENCH_r05 isolated-dispatch baseline."""
+    the sharded engine's tokens/sec, the prefix-cache hit-TTFT A/B pair,
+    and the coalesced device dispatch rate vs the BENCH_r05
+    isolated-dispatch baseline."""
     rows = [json.loads(l) for l in serving_bench_run.stdout.splitlines()
             if l.startswith("{")]
     by = {r["metric"]: r for r in rows}
     assert set(by) == {"serving_tokens_per_sec", "serving_ttft_ms",
                        "serving_continuous_vs_static",
                        "serving_sharded_tokens_per_s",
+                       "serving_prefix_hit_ttft_ms",
+                       "serving_prefix_hit_ratio",
                        "device_op_rate"}, \
         serving_bench_run.stdout
     assert by["serving_tokens_per_sec"]["unit"] == "tokens/s"
@@ -214,6 +217,29 @@ def test_serving_continuous_beats_static_by_1_5x(serving_bench_run):
     lane = [l for l in serving_bench_run.stderr.splitlines()
             if l.startswith("# serving lane:")]
     assert lane and "OK 1.5x floor" in lane[0], \
+        serving_bench_run.stderr[-2000:]
+
+
+def test_serving_prefix_hit_ttft_floor(serving_bench_run):
+    """The prefix-cache acceptance floor: on the shared-prefix corpus a
+    warm (cache-hit) generation's TTFT must come in at no more than half
+    the cold engine's — the radix fork replaces O(prompt) prefill with
+    one decode-shaped suffix launch."""
+    rows = [json.loads(l) for l in serving_bench_run.stdout.splitlines()
+            if l.startswith("{")]
+    hit = [r for r in rows
+           if r["metric"] == "serving_prefix_hit_ttft_ms"][0]
+    assert hit["unit"] == "ms" and hit["value"] > 0, hit
+    assert hit["cold_ms"] > 0, hit
+    assert hit["value"] <= 0.5 * hit["cold_ms"], hit
+    assert hit["ratio"] <= 0.5, hit
+    ratio = [r for r in rows
+             if r["metric"] == "serving_prefix_hit_ratio"][0]
+    # warmup primes the tree: all but the very first request hit
+    assert ratio["unit"] == "ratio" and ratio["value"] >= 0.5, ratio
+    lane = [l for l in serving_bench_run.stderr.splitlines()
+            if l.startswith("# serving prefix:")]
+    assert lane and "OK 0.5x ceiling" in lane[0], \
         serving_bench_run.stderr[-2000:]
 
 

@@ -18,6 +18,7 @@ EXPECTED_RULES = {
     "lock-order", "version-guard", "metric-flag-hygiene", "bounded-spin",
     "named-thread", "cross-process-ownership", "metric-churn",
     "no-per-token-host-sync", "no-per-op-step-dispatch",
+    "cow-before-write",
 }
 
 
@@ -887,6 +888,71 @@ class TestNoPerOpStepDispatch:
             def probe(self, handles):
                 for h in handles:
                     self.store.copy(h)  # tpulint: disable=no-per-op-step-dispatch
+            """}, rules=self.RULE)
+        assert res.clean
+        assert len(res.suppressed) == 1
+
+
+# --------------------------------------------------------- cow-before-write
+class TestCowBeforeWrite:
+    RULE = ["cow-before-write"]
+
+    def test_bare_pool_write_fires(self, tmp_path):
+        res = _lint(tmp_path, {"serving/model.py": """\
+            def prefill(self, tokens, table):
+                kpool, vpool = self._fn(tokens, table)
+                self.kv.update_pools(kpool, vpool)
+            """}, rules=self.RULE)
+        assert [f.rule for f in res.findings] == ["cow-before-write"]
+        assert res.findings[0].line == 3
+        assert "cow-split" in res.findings[0].message
+
+    def test_assert_writable_guard_passes(self, tmp_path):
+        # the house contract: prove exclusivity before the scatter commits
+        res = _lint(tmp_path, {"serving/model.py": """\
+            def prefill(self, tokens, table):
+                self.kv.assert_writable(table, 0, len(tokens))
+                kpool, vpool = self._fn(tokens, table)
+                self.kv.update_pools(kpool, vpool)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_cow_split_call_passes(self, tmp_path):
+        res = _lint(tmp_path, {"serving/engine.py": """\
+            def step(self, seq, k, v):
+                self.kv.cow_block(seq.seq_id, 0)
+                self.kv.update_pools(k, v)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_refcount_eq_one_check_passes(self, tmp_path):
+        res = _lint(tmp_path, {"serving/custom_cache.py": """\
+            def swap(self, block, k, v):
+                if self._ref.get(block, 0) == 1:
+                    self.update_pools(k, v)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_cow_named_function_exempt(self, tmp_path):
+        # the split implementations themselves ARE the guard
+        res = _lint(tmp_path, {"serving/kv_cache.py": """\
+            def _cow_copy_block_device(self, dst, src):
+                k = self.k_pool
+                self.update_pools(k, k)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_same_code_outside_scope_passes(self, tmp_path):
+        res = _lint(tmp_path, {"tpu/device_lane.py": """\
+            def stage(self, k, v):
+                self.kv.update_pools(k, v)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_suppression_honored(self, tmp_path):
+        res = _lint(tmp_path, {"serving/debug.py": """\
+            def poke(self, k, v):
+                self.kv.update_pools(k, v)  # tpulint: disable=cow-before-write
             """}, rules=self.RULE)
         assert res.clean
         assert len(res.suppressed) == 1

@@ -433,7 +433,8 @@ class TestEngineRealModel:
         snap = serving.snapshot()
         assert snap["scheduling"] == "continuous"
         assert snap["steps"] > 0 and snap["tokens_generated"] > 0
-        assert snap["kv"]["blocks_used"] == 0  # nothing in flight
+        # nothing in flight: only radix-tree-held prefix chains remain
+        assert snap["kv"]["blocks_used"] == snap["kv"]["blocks_cached"]
         assert snap["step_us_p50"] > 0
 
 

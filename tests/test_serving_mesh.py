@@ -9,6 +9,7 @@ single-device output" is an exact list equality, not a tolerance check.
 """
 
 import threading
+import time
 import types
 
 import numpy as np
@@ -151,8 +152,10 @@ class TestMeshEquivalence:
             assert [s["shard"] for s in shards] == [0, 1]
             assert all(s["blocks_total"] > 0 and s["devices"]
                        for s in shards)
-            # every completed sequence freed its blocks again
-            assert all(s["blocks_used"] == 0 for s in shards)
+            # every completed sequence freed its blocks again; what stays
+            # used is exactly the prefix cache's committed chains
+            assert all(s["blocks_used"] == s["blocks_cached"]
+                       for s in shards)
             assert "shard_steps" in snap and snap["shard_steps"]
             status, _ctype, text = serving_service(
                 None, types.SimpleNamespace(query={}, path="/serving"))
@@ -364,6 +367,61 @@ class TestShardedGenerateChaos:
                 kv.assert_idle()
                 model.close()
 
+    def test_owner_shard_death_with_warm_prefix_leaks_nothing(self):
+        """Chaos x prefix cache: the owning shard dies mid-Generate while
+        the doomed sequence is FORKED from a committed radix chain. The
+        abort must return only the sequence's own holds — the tree's
+        refcounts stay consistent under the armed ledger (any drift
+        raises inside the engine's per-step audit), and after stop()
+        clears the tree the pool is bit-for-bit whole."""
+        fleet = self._fleet(n_layers=2)
+        try:
+            url = (f"list://{fleet[0][0].listen_endpoint()} 0/2,"
+                   f"{fleet[1][0].listen_endpoint()} 1/2")
+            ch = ShardedLlmChannel(
+                url, 2, options=ChannelOptions(protocol="trpc_std",
+                                               timeout_ms=60000))
+            # block_size=16: a 48-token prompt commits 3 full blocks, so
+            # the repeat warm pass (and the doomed request) fork 2 of them
+            req = serving_pb2.GenerateRequest(prompt_len=48,
+                                              max_new_tokens=200)
+            owner = ch.shard_of(req)
+            owner_engine = fleet[owner][1]
+            warms = [ch.generate(serving_pb2.GenerateRequest(
+                prompt_len=48, max_new_tokens=4)) for _ in range(2)]
+            # the warm hit is bit-identical to the cold pass
+            assert list(warms[0].tokens) == list(warms[1].tokens)
+            pfx = owner_engine.snapshot()["prefix"]
+            assert pfx["hit_seqs"] >= 1 and pfx["blocks"] > 0, pfx
+
+            def kill(srv=fleet[owner][0]):
+                srv.stop()
+                srv.join(timeout=0)
+
+            killer = threading.Timer(0.05, kill)
+            killer.start()
+            try:
+                with pytest.raises(RpcError) as ei:
+                    ch.generate(req)
+            finally:
+                killer.cancel()
+            assert ei.value.error_code == errors.EFAILEDSOCKET
+            deadline = time.monotonic() + 5.0
+            while owner_engine.running_count and time.monotonic() < deadline:
+                time.sleep(0.005)
+            # the doomed fork's private blocks came back; exactly the
+            # tree-held committed chains stay pinned
+            snap = owner_engine.kv.snapshot()
+            assert snap["blocks_cached"] > 0
+            assert snap["blocks_used"] == snap["blocks_cached"]
+        finally:
+            for srv, engine, model, kv in fleet:
+                srv.stop()
+                srv.join(timeout=2)
+                engine.stop()  # clears the radix tree's holds
+                kv.assert_idle()  # zero leaked blocks, zero cache holds
+                model.close()
+
     def test_fleet_stats_merge_across_shards(self):
         fleet = self._fleet(n_layers=2)
         try:
@@ -386,7 +444,10 @@ class TestShardedGenerateChaos:
             assert stats.tokens_generated == 8
             # fleet totals: both pools' capacity summed
             assert stats.kv_blocks_total == 2 * 64
-            assert stats.kv_blocks_used == 0
+            # in-flight work drained; only prefix-cache chains stay used
+            cached = sum(e.kv.snapshot()["blocks_cached"]
+                         for _s, e, _m, _k in fleet)
+            assert stats.kv_blocks_used == cached
         finally:
             for srv, engine, model, kv in fleet:
                 srv.stop()

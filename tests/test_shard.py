@@ -439,7 +439,8 @@ class TestShardPlaneEndToEnd:
             while time.monotonic() < deadline:
                 per_worker = [w["dispatched"]
                               for w in plane.state_dict()["workers"]]
-                if all(d > 0 for d in per_worker):
+                if all(d > 0 for d in per_worker) \
+                        and sum(per_worker) >= 160:
                     break
                 time.sleep(0.05)
             assert all(d > 0 for d in per_worker), per_worker
