@@ -554,14 +554,158 @@ def _bench_prefix_ttft():
     return hit_ms, cold_ms, hit_ratio
 
 
+def _bench_disagg_interference():
+    """Disaggregated prefill/decode interference A/B: the same 3:1 mixed
+    corpus (three short decode-heavy requests, then one long prefill)
+    through (a) ONE co-located engine, where every long prefill launch
+    stalls the decode steps sharing its loop, and (b) a prefill engine
+    that hands each just-prefilled sequence to a separate decode engine
+    over the tpu:// record lane (KVMigrator -> loopback LlmService ->
+    adopt). The decode engine then runs NOTHING but (1,1) decode steps,
+    so its inter-token jitter (p99-p50 of per-engine ITL samples) must
+    come in below the co-located engine's — that spread IS the
+    interference the disaggregation removes. Returns
+    (coloc_jitter_ms, disagg_jitter_ms, coloc_ttft_ms, disagg_ttft_ms,
+    migrator_snapshot)."""
+    import numpy as np
+
+    from brpc_tpu.rpc.server import Server
+    from brpc_tpu.serving import (EngineConfig, KVCacheConfig, ModelConfig,
+                                  PagedKVCache, ServingEngine,
+                                  TinyTransformer)
+    from brpc_tpu.serving.migration import KVMigrator
+    from brpc_tpu.serving.service import LlmServingService
+
+    n = 16 if QUICK else 32
+    corpus = [(160, 4) if i % 4 == 3 else (16, 24) for i in range(n)]
+    cfg = ModelConfig(vocab=256, d_model=32, n_heads=2, n_layers=2,
+                      max_context=256)
+
+    def build(role):
+        kv = PagedKVCache(KVCacheConfig(block_size=16, num_blocks=256),
+                          cfg.n_layers, cfg.kv_dim)
+        model = TinyTransformer(cfg, kv)
+        # prefix_cache=False: this A/B isolates scheduling interference —
+        # cached-prefix reuse would shrink exactly the prefill launches
+        # the co-located decode steps stall behind
+        return ServingEngine(model, kv, EngineConfig(
+            max_batch=4, token_budget=256, idle_wait_s=0.002, role=role),
+            prefix_cache=False).start()
+
+    def submit(eng, plen, max_new, resume=0):
+        ev = threading.Event()
+        box = {}
+        prompt = (np.zeros(0, dtype=np.int32) if resume
+                  else eng.model.synth_prompt(plen))
+        code, _ = eng.submit(
+            prompt, 0 if resume else max_new,
+            done=lambda r, box=box, ev=ev: (box.update(r=r), ev.set()),
+            resume_seq_id=resume)
+        if code != 0:
+            raise RuntimeError(f"disagg bench submit rejected: {code}")
+        return ev, box
+
+    def run_coloc(eng):
+        pend = [submit(eng, p, m) for p, m in corpus]
+        for ev, _ in pend:
+            if not ev.wait(300):
+                raise RuntimeError("disagg bench: co-located run stalled")
+
+    def run_disagg(pre, dec):
+        stage1 = [submit(pre, p, m) for p, m in corpus]
+        for ev, box in stage1:
+            if not ev.wait(300):
+                raise RuntimeError("disagg bench: prefill stage stalled")
+            r = box["r"]
+            if r is None or r.finish_reason != "handoff":
+                raise RuntimeError(
+                    f"disagg bench: expected handoff, got "
+                    f"{getattr(r, 'finish_reason', None)!r}")
+        stage2 = [submit(dec, 0, 0, resume=box["r"].seq_id)
+                  for _, box in stage1]
+        for ev, _ in stage2:
+            if not ev.wait(300):
+                raise RuntimeError("disagg bench: decode stage stalled")
+
+    def jitter_ms(samples):
+        s = sorted(samples)
+        if not s:
+            return 0.0
+        return (_percentile(s, 0.99) - _percentile(s, 0.5)) / 1e3
+
+    def ttft_ms(samples):
+        s = sorted(samples)
+        return (_percentile(s, 0.5) / 1e3) if s else 0.0
+
+    def warm_buckets(eng):
+        # deterministically compile every (batch, context) decode bucket
+        # the timed reps can hit — a mid-run jit trace (hundreds of ms)
+        # would otherwise masquerade as scheduling jitter in a p99 drawn
+        # from a few hundred samples
+        for group in ([(160, 4)] * 4, [(16, 4)] * 4, [(160, 4)],
+                      [(16, 4)]):
+            pend = [submit(eng, p, m) for p, m in group]
+            for ev, _ in pend:
+                if not ev.wait(300):
+                    raise RuntimeError(
+                        "disagg bench: bucket warmup stalled")
+
+    REPS = 3  # min-of-reps: p99 from ~300 samples is one GC pause from
+    #           flipping the A/B, so each mode keeps its best draw
+
+    coloc = build("both")
+    try:
+        # warmup covers every (batch, context) bucket the timed run hits,
+        # twice for the donated-pool second jit signature
+        run_coloc(coloc)
+        run_coloc(coloc)
+        warm_buckets(coloc)
+        coloc_j = coloc_t = float("inf")
+        for _ in range(REPS):
+            coloc.itl_samples.clear()
+            coloc.ttft_samples.clear()
+            run_coloc(coloc)
+            coloc_j = min(coloc_j, jitter_ms(coloc.itl_samples))
+            coloc_t = min(coloc_t, ttft_ms(coloc.ttft_samples))
+    finally:
+        coloc.stop()
+        coloc.model.close()
+
+    dec = build("decode")
+    srv = Server().add_service(LlmServingService(dec)).start("127.0.0.1:0")
+    pre = build("prefill")
+    pre.set_migrator(KVMigrator(f"{srv.listen_endpoint()}"))
+    try:
+        run_disagg(pre, dec)
+        run_disagg(pre, dec)
+        warm_buckets(dec)
+        dis_j = dis_t = float("inf")
+        for _ in range(REPS):
+            pre.ttft_samples.clear()
+            dec.itl_samples.clear()
+            run_disagg(pre, dec)
+            dis_j = min(dis_j, jitter_ms(dec.itl_samples))
+            dis_t = min(dis_t, ttft_ms(pre.ttft_samples))
+        mig = pre.migrator.snapshot()
+    finally:
+        pre.stop()
+        srv.stop()
+        srv.join(timeout=2)
+        dec.stop()
+        pre.model.close()
+        dec.model.close()
+    return coloc_j, dis_j, coloc_t, dis_t, mig
+
+
 def bench_serving_lane():
     """Serving plane (brpc_tpu/serving/): streamed generations over the
     RPC path against a pre-warmed child server — aggregate tokens/sec and
     TTFT percentiles measured at stream-frame arrival — then the
     in-process continuous-vs-static scheduling A/B on mixed-length
     traffic over the SHARDED mesh stack, the prefix-cache hit-TTFT A/B,
-    plus the coalesced device dispatch-rate probe. Emits the seven
-    serving JSON metric lines."""
+    the disaggregated prefill/decode interference A/B, plus the coalesced
+    device dispatch-rate probe. Emits the ten serving JSON metric
+    lines."""
     from brpc_tpu.proto import serving_pb2
     from brpc_tpu.rpc import Channel, ChannelOptions, Controller, Stub
     from brpc_tpu.rpc.stream import (StreamOptions, stream_close,
@@ -643,6 +787,7 @@ def bench_serving_lane():
     ratio = cont_qps / max(stat_qps, 1e-9)
     hit_ms, cold_ms, hit_ratio = _bench_prefix_ttft()
     pfx_ratio = hit_ms / max(cold_ms, 1e-9)
+    coloc_j, dis_j, coloc_t, dis_t, mig = _bench_disagg_interference()
     op_rate, n_ops = _device_op_rate()
     import jax as _jax
     n_dev = len(_jax.devices())
@@ -661,6 +806,12 @@ def bench_serving_lane():
           f"cold={cold_ms:.2f}ms ratio={pfx_ratio:.3f} "
           f"({'OK' if pfx_ratio <= 0.5 else 'ABOVE'} 0.5x ceiling) "
           f"hit_ratio={hit_ratio:.2f}", file=sys.stderr)
+    print(f"# serving disagg: 3:1 mixed corpus decode jitter "
+          f"coloc={coloc_j:.3f}ms disagg={dis_j:.3f}ms "
+          f"({'OK' if dis_j < coloc_j else 'ABOVE'} interference floor) "
+          f"ttft coloc={coloc_t:.2f}ms disagg={dis_t:.2f}ms | "
+          f"migrated seqs={mig['seqs']} blocks={mig['blocks']} "
+          f"at {mig['gbps']:.3f} GB/s", file=sys.stderr)
     print(json.dumps({
         "metric": "serving_tokens_per_sec",
         "value": round(tps, 1),
@@ -696,6 +847,25 @@ def bench_serving_lane():
         "metric": "serving_prefix_hit_ratio",
         "value": round(hit_ratio, 4),
         "unit": "ratio",
+    }))
+    print(json.dumps({
+        "metric": "serving_disagg_decode_jitter",
+        "value": round(dis_j, 4),
+        "unit": "ms",
+        "coloc_ms": round(coloc_j, 4),
+    }))
+    print(json.dumps({
+        "metric": "serving_disagg_ttft_ms",
+        "value": round(dis_t, 3),
+        "unit": "ms",
+        "coloc_ms": round(coloc_t, 3),
+    }))
+    print(json.dumps({
+        "metric": "serving_migrate_gbps",
+        "value": round(mig["gbps"], 4),
+        "unit": "GB/s",
+        "seqs": mig["seqs"],
+        "blocks": mig["blocks"],
     }))
     print(json.dumps({
         "metric": "device_op_rate",

@@ -1058,3 +1058,68 @@ def rule_metric_churn(pkg: Package) -> List[Finding]:
                         f"and grows /vars (and its series rings) per "
                         f"call; expose once at module scope"))
     return out
+
+
+# --------------------------------------------------------------------------
+# Rule 15: quiesce-before-migrate
+# --------------------------------------------------------------------------
+# The migration plane's ownership contract (docs/serving.md): a block
+# chain may only leave a shard through export_chain(), and export is only
+# sound over a sequence that has been quiesced — audited and marked
+# read-only — in the same control flow. Exporting a chain that another
+# step could still extend/cow races the record stream against the
+# scheduler: the destination adopts a stale table while the source keeps
+# writing. The runtime guard (export_chain asserts the quiesce mark)
+# catches it under BRPC_TPU_CHECK; this rule catches it at lint time for
+# paths tests never arm.
+
+_MIGRATE_SCOPE_PREFIXES = ("serving/",)
+
+
+def _export_sites(func: ast.AST) -> List[ast.Call]:
+    sites: List[ast.Call] = []
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            name = attr_chain(node.func)
+            if name is not None and name.split(".")[-1] == "export_chain":
+                sites.append(node)
+    return sites
+
+
+def _quiesce_guarded(func: ast.AST) -> bool:
+    """True when the function proves the sequence is quiesced before
+    exporting: any quiesce_* call in the same function body."""
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            name = attr_chain(node.func)
+            if name is not None and "quiesce" in name.split(".")[-1]:
+                return True
+    return False
+
+
+@register_rule(
+    "quiesce-before-migrate",
+    "serving/ functions that export a KV block chain (export_chain) must "
+    "quiesce the sequence in the same function first — migrating a chain "
+    "the scheduler can still write races the record stream")
+def rule_quiesce_before_migrate(pkg: Package) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in pkg.files:
+        if not in_scope(sf.rel, prefixes=_MIGRATE_SCOPE_PREFIXES):
+            continue
+        for func, cls in iter_functions(sf.tree):
+            if "quiesce" in func.name or "export" in func.name:
+                continue  # the quiesce/export implementations themselves
+            sites = _export_sites(func)
+            if not sites or _quiesce_guarded(func):
+                continue
+            where = f"{cls}.{func.name}" if cls else func.name
+            for call in sites:
+                out.append(Finding(
+                    "quiesce-before-migrate", sf.rel, call.lineno,
+                    f"{where}() exports a KV block chain (export_chain) "
+                    f"with no quiesce call in scope — the scheduler can "
+                    f"still extend/cow the sequence while its blocks "
+                    f"stream out; call kv.quiesce_sequence first and "
+                    f"unquiesce on failure"))
+    return out

@@ -678,6 +678,25 @@ def serving_service(server, http: HttpMessage):
                 f"evicted={pfx['evicted_blocks']} "
                 f"hit_ratio={pfx['hit_ratio']:.2f}"
                 + ("" if pfx.get("enabled", True) else " (disabled)"))
+        # disaggregated serving: outbound handoff counters on prefill
+        # engines, inbound adoption counters on decode engines, plus the
+        # parked (adopted-not-yet-attached) sequence count
+        mig = s.get("migration")
+        if mig:
+            line = (f"  migrate: role={s.get('role', 'both')} "
+                    f"parked={mig['parked']}")
+            mo = mig.get("out")
+            if mo:
+                line += (f" | out -> {mo['dest']} (shard {mo['dest_shard']})"
+                         f" seqs={mo['seqs']} blocks={mo['blocks']} "
+                         f"bytes={mo['bytes']} failed={mo['failed']} "
+                         f"gbps={mo['gbps']:.3f}")
+            mi = mig.get("in")
+            if mi:
+                line += (f" | in seqs={mi['seqs_in']} "
+                         f"failed={mi['failed_in']} "
+                         f"pending={mi['pending_in']}")
+            out.append(line)
         # sharded pools: per-device occupancy, per-shard step latency,
         # and which shard owns each live sequence's block table
         if "shards" in kv:

@@ -291,3 +291,21 @@ serving_prefix_thrash_rate = define(
     "sustains above this many blocks/s — the tree is churning instead "
     "of caching (reloadable: the rule reads the flag at every tick)",
     validator=_positive)
+serving_migrate_window_mb = define(
+    "serving_migrate_window_mb", 64,
+    "credit window (MiB of staged HBM bytes) for the KV-migration "
+    "record stream: the prefill shard stalls exactly when the decode "
+    "shard holds this many unconsumed migrated-block bytes",
+    validator=_positive)
+serving_migrate_timeout_ms = define(
+    "serving_migrate_timeout_ms", 30000,
+    "per-sequence migration deadline: MigrateCommit gives up (and the "
+    "source retains the chain, falling back to local decode) if the "
+    "destination has not adopted every block within this bound",
+    validator=_positive)
+serving_migrate_backlog_max = define(
+    "serving_migrate_backlog_max", 8.0,
+    "serving_migrate_backlog watch rule fires when more than this many "
+    "KV migrations are in flight at once — prefill shards are shipping "
+    "chains faster than decode shards adopt them (reloadable: the rule "
+    "reads the flag at every tick)", validator=_positive)

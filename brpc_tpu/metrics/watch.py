@@ -288,3 +288,11 @@ def install_default_rules() -> None:
         "serving_prefix_thrash", "g_serving_prefix_evicted_blocks",
         KIND_RATE, ">", 20, window_s=10, for_ticks=2, clear_ticks=5,
         value_fn=lambda: _flags.get("serving_prefix_thrash_rate")))
+    # disaggregated serving: migrations stacking up in flight mean the
+    # record lane (or the decode side's adoption path) cannot keep pace
+    # with prefill handoffs — decode shards are about to see TTFT cliffs.
+    # Bound is the reloadable serving_migrate_backlog_max flag
+    w.add(WatchRule(
+        "serving_migrate_backlog", "g_serving_migrate_inflight",
+        KIND_THRESHOLD, ">", 8, window_s=10, for_ticks=2, clear_ticks=5,
+        value_fn=lambda: _flags.get("serving_migrate_backlog_max")))

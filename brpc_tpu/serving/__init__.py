@@ -30,6 +30,12 @@ mechanism:
   cached prefix (zero copies), completion commits blocks back
   (insert-or-share), eviction is watermark-aware LRU over refcount-1
   chains.
+- :mod:`brpc_tpu.serving.migration` — live KV block-chain migration over
+  the ``tpu://`` record lane: a prefill shard hands a just-prefilled
+  sequence to a decode shard (disaggregated serving), and a dying shard
+  drains its live sequences onto survivors, with the paged ledger's
+  quiesce/export/adopt handshake keeping block ownership single-writer
+  throughout.
 """
 
 from brpc_tpu.serving.kv_cache import (KVCacheConfig, PagedKVCache,
@@ -52,6 +58,14 @@ def __getattr__(name):
     if name == "ShardedLlmChannel":
         from brpc_tpu.serving.router import ShardedLlmChannel
         return ShardedLlmChannel
+    # the migration plane imports lazily too: co-located deployments
+    # never pay for the record-lane / fault wiring at import time
+    if name == "KVMigrator":
+        from brpc_tpu.serving.migration import KVMigrator
+        return KVMigrator
+    if name == "MigrationReceiver":
+        from brpc_tpu.serving.migration import MigrationReceiver
+        return MigrationReceiver
     raise AttributeError(name)
 
 
@@ -62,4 +76,5 @@ __all__ = [
     "PrefixCache", "ShardedPrefixCache", "build_prefix_cache",
     "prefix_route_key",
     "LlmServingService", "ShardedLlmChannel",
+    "KVMigrator", "MigrationReceiver",
 ]

@@ -100,13 +100,20 @@ SCHED_CONTINUOUS = "continuous"
 SCHED_STATIC = "static"
 
 
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_BOTH = "both"
+
+
 class EngineConfig:
     def __init__(self, max_batch: int = 8, token_budget: int = 512,
                  max_queue: int = 64, max_new_tokens_cap: int = 512,
                  scheduling: str = SCHED_CONTINUOUS,
-                 idle_wait_s: float = 0.05):
+                 idle_wait_s: float = 0.05, role: str = ROLE_BOTH):
         if scheduling not in (SCHED_CONTINUOUS, SCHED_STATIC):
             raise ValueError(f"unknown scheduling {scheduling!r}")
+        if role not in (ROLE_PREFILL, ROLE_DECODE, ROLE_BOTH):
+            raise ValueError(f"unknown role {role!r}")
         self.max_batch = max_batch
         # per-step budget over prefill tokens + one decode token per
         # running sequence — the Orca iteration-level knob
@@ -115,6 +122,12 @@ class EngineConfig:
         self.max_new_tokens_cap = max_new_tokens_cap
         self.scheduling = scheduling
         self.idle_wait_s = idle_wait_s
+        # disaggregated serving: a "prefill" engine runs prefill then
+        # migrates each chain to its KVMigrator's destination (falling
+        # back to local decode when migration fails); a "decode" engine
+        # mostly adopts migrated sequences but still accepts fresh
+        # submissions (roles are scheduling placement, not capability)
+        self.role = role
 
 
 STATE_WAITING = "waiting"
@@ -149,6 +162,16 @@ class Sequence:
         self.t_first_token = 0.0
         self.t_last_token = 0.0
         self.finish_reason = ""
+        # disaggregation: a migrated-in sequence is "adopted" and decodes
+        # with no client bound until a stage-2/retry Generate attaches;
+        # handoff_base marks how many out_tokens the prefill shard
+        # already returned (a resume attach replies only the suffix)
+        self.adopted = False
+        self.handoff_base = 0
+        self.resume_attach = False
+        self._attached = False
+        self._deferred: Optional[tuple] = None
+        self.t_adopted = 0.0
 
     @property
     def pos(self) -> int:
@@ -181,6 +204,27 @@ class ServingEngine:
         self.tokens_generated = 0
         self.last_step_us = 0.0
         self._occupancy_sum = 0
+        # disaggregation plumbing: the migrator ships chains OUT (set via
+        # set_migrator), the receiver (installed by LlmServingService)
+        # adopts chains IN; _adopted parks migrated-in sequences until a
+        # stage-2/retry Generate attaches a client to them
+        self.migrator = None
+        self._migration_rx = None
+        self._adopted: Dict[int, Sequence] = {}
+        # adopted chains wait here for a max_batch slot — direct entry
+        # into _running would let migration bursts inflate the decode
+        # batch past any size admission ever dispatches
+        self._adopted_pending: Deque[Sequence] = collections.deque()
+        # serializes pool mutation between the step loop (prefill/decode
+        # donate the pool buffers) and migration adoption's host-side
+        # scatter — concurrent writers see deleted/donated buffers
+        self.pool_gate = threading.Lock()
+        self._recover_index: Dict[tuple, Deque[int]] = {}
+        # per-engine counters the disaggregation oracle and bench need
+        # (the g_serving_* fleet vars cannot isolate one engine)
+        self.prefill_tokens = 0
+        self.ttft_samples: List[float] = []  # us, bounded
+        self.itl_samples: List[float] = []   # us, bounded
         # per-shard decode attribution: shard -> [steps, total_us,
         # last_us, seq_steps] (only shards with live sequences tick)
         self._shard_step: Dict[int, List[float]] = {}
@@ -198,6 +242,14 @@ class ServingEngine:
         self._thread.start()
         return self
 
+    def set_migrator(self, migrator) -> "ServingEngine":
+        """Install the outbound KV migrator (serving/migration.py). A
+        prefill-role engine hands every prefilled chain to it from the
+        step loop; ANY engine with one drains live sequences to the
+        destination on stop() instead of aborting them from scratch."""
+        self.migrator = migrator
+        return self
+
     def stop(self, abort_code: int = errors.ELOGOFF) -> None:
         with self._cv:
             if not self.running:
@@ -207,9 +259,17 @@ class ServingEngine:
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
+        # shard-death recovery: with a migrator installed, live chains
+        # move to the survivor (the step loop is parked, so every
+        # sequence is quiescent) instead of dying retry-from-scratch
+        if self.migrator is not None:
+            self._drain_migrate()
         # fan a retriable error to anything still in flight, then prove
         # the pool whole — the CreditLedger teardown discipline
         self._abort_all_locked_out(abort_code, "engine stopped")
+        with self._cv:
+            self._adopted.clear()
+            self._recover_index.clear()
         if self.prefix is not None:
             # release every tree hold so assert_idle sees the pool whole
             self.prefix.clear()
@@ -220,16 +280,36 @@ class ServingEngine:
     # ------------------------------------------------------------ admission
     def submit(self, prompt: np.ndarray, max_new_tokens: int,
                stop_token: int = 0, cntl=None, done=None,
-               stream_id: int = 0) -> "tuple[int, Optional[Sequence]]":
+               stream_id: int = 0,
+               resume_seq_id: int = 0) -> "tuple[int, Optional[Sequence]]":
         """Admission front door (runs on the RPC thread). Returns
         (error_code, seq): 0 + the queued sequence, or a reject code the
-        caller surfaces through cntl.set_failed."""
+        caller surfaces through cntl.set_failed.
+
+        ``resume_seq_id`` attaches to a migrated-in sequence (two-stage
+        disaggregated dispatch: the stage-1 handoff reply named it) —
+        no admission, no allocation, the chain is already here."""
+        if resume_seq_id:
+            with self._cv:
+                seq = self._adopted.get(resume_seq_id)
+            if seq is None:
+                return errors.EREQUEST, None
+            return self._bind_attach(seq, cntl, done, stream_id,
+                                     resume=True)
         if max_new_tokens < 1:
             return errors.EREQUEST, None
         max_new_tokens = min(max_new_tokens, self.config.max_new_tokens_cap)
         if len(prompt) < 1 or (len(prompt) + max_new_tokens
                                > self.model.config.max_context):
             return errors.EREQUEST, None
+        # shard-death recovery: a retried request whose sequence was
+        # drain-migrated here picks up the live generation instead of
+        # re-prefilling a single token
+        if self._recover_index:
+            cand = self._recover_match(prompt, max_new_tokens, stop_token)
+            if cand is not None:
+                return self._bind_attach(cand, cntl, done, stream_id,
+                                         resume=False)
         # deadline at admission (PR 4's server-side enforcement, re-checked
         # here exactly like the batch runtime re-checks at enqueue)
         deadline = getattr(cntl, "deadline_mono", 0.0) if cntl else 0.0
@@ -290,6 +370,101 @@ class ServingEngine:
     def running_count(self) -> int:
         return len(self._running)
 
+    # -------------------------------------------------- migration adoption
+    def make_adopted_sequence(self, prompt: np.ndarray,
+                              out_tokens: List[int], max_new_tokens: int,
+                              stop_token: int = 0) -> Sequence:
+        """Fabricate the destination-side Sequence for a migrated chain.
+        The caller (MigrationReceiver) adopts the KV under the returned
+        ``seq_id`` BEFORE handing it to :meth:`adopt_migrated` — the
+        sequence must never be visible to the step loop without blocks."""
+        seq = Sequence(prompt, max_new_tokens, stop_token)
+        seq.out_tokens = list(out_tokens)
+        seq.handoff_base = len(out_tokens)
+        seq.adopted = True
+        seq.state = STATE_RUNNING
+        # out_tokens is never empty post-prefill: TTFT was recorded by
+        # the source shard. t_last_token stays 0 so the first local
+        # decode RESETS the ITL clock — transfer + slot-wait latency
+        # belongs to the handoff, not this engine's inter-token gap
+        seq.t_first_token = time.monotonic()
+        seq.t_last_token = 0.0
+        return seq
+
+    def adopt_migrated(self, seq: Sequence, recovery: bool = False) -> bool:
+        """Queue a migrated-in sequence for decode (its KV is already
+        adopted). The step loop drains it into the running set under the
+        same max_batch cap as admission; tokens buffer on the sequence
+        until a client attaches. ``recovery`` additionally indexes it
+        for prompt-match attach (shard-death retry traffic has no
+        resume_seq_id — the original reply never arrived)."""
+        with self._cv:
+            if not self.running:
+                return False
+            seq.t_adopted = time.monotonic()
+            self._adopted[seq.seq_id] = seq
+            if recovery:
+                key = (tuple(int(t) for t in seq.prompt),
+                       int(seq.max_new_tokens), int(seq.stop_token))
+                self._recover_index.setdefault(
+                    key, collections.deque()).append(seq.seq_id)
+            self._adopted_pending.append(seq)
+            self._cv.notify()
+        return True
+
+    def _recover_match(self, prompt: np.ndarray, max_new_tokens: int,
+                       stop_token: int) -> Optional[Sequence]:
+        key = (tuple(int(t) for t in prompt), int(max_new_tokens),
+               int(stop_token))
+        with self._cv:
+            dq = self._recover_index.get(key)
+            while dq:
+                rid = dq.popleft()
+                if not dq:
+                    self._recover_index.pop(key, None)
+                    dq = None
+                cand = self._adopted.get(rid)
+                if cand is not None and not cand._attached:
+                    return cand
+        return None
+
+    def _bind_attach(self, seq: Sequence, cntl, done, stream_id: int,
+                     resume: bool) -> "tuple[int, Optional[Sequence]]":
+        """Attach a client to a parked migrated sequence. Live sequences
+        stream the tokens generated since the handoff point and keep
+        decoding; already-finished ones complete the RPC immediately
+        from the deferred result."""
+        with self._cv:
+            if seq._attached or seq.done is not None:
+                return errors.EREQUEST, None
+            seq._attached = True
+            seq.resume_attach = resume
+            seq.cntl = cntl
+            seq.stream_id = stream_id
+            deferred = seq._deferred
+            base = seq.handoff_base if resume else 0
+            replay = list(seq.out_tokens[base:])
+            finished = seq.state == STATE_DONE
+            if deferred is None:
+                seq.done = done
+            else:
+                self._adopted.pop(seq.seq_id, None)
+        if deferred is not None:
+            code, reason = deferred
+            try:
+                if code != 0 and cntl is not None:
+                    cntl.set_failed(code, reason)
+                    done(None)
+                else:
+                    done(self._response_for(seq))
+            except Exception:
+                pass
+            return 0, seq
+        if replay and stream_id:
+            # catch the client up on tokens decoded before it attached
+            self._stream_delta(seq, replay, finished)
+        return 0, seq
+
     # ------------------------------------------------------------ step loop
     def _loop(self) -> None:
         _prof.register_current_thread("serving")
@@ -297,7 +472,8 @@ class ServingEngine:
             while True:
                 with self._cv:
                     while (self.running and not self._waiting
-                           and not self._running):
+                           and not self._running
+                           and not self._adopted_pending):
                         self._cv.wait(self.config.idle_wait_s)
                     if not self.running:
                         return
@@ -308,7 +484,8 @@ class ServingEngine:
                     time.sleep(0.002)
                     continue
                 try:
-                    self._step(admitted)
+                    with self.pool_gate:
+                        self._step(admitted)
                 except Exception as e:  # engine must survive a bad step
                     for seq in list(self._running):
                         self._finish(seq, errors.EINTERNAL,
@@ -325,6 +502,13 @@ class ServingEngine:
         if cfg.scheduling == SCHED_STATIC and self._running:
             return []
         admitted: List[Sequence] = []
+        # migrated-in chains first (already prefilled, zero prefill
+        # cost) — capped by max_batch so the decode batch never exceeds
+        # a size admission itself would dispatch
+        while self._adopted_pending and len(self._running) < cfg.max_batch:
+            seq = self._adopted_pending.popleft()
+            self._running.append(seq)
+            admitted.append(seq)
         budget = cfg.token_budget - len(self._running)
         while (self._waiting and len(self._running) < cfg.max_batch
                and budget >= self._prefill_cost(self._waiting[0])):
@@ -402,6 +586,8 @@ class ServingEngine:
             prev = _prof.set_phase("prefill")
             try:
                 for seq in admitted:
+                    if seq.adopted:
+                        continue  # chain arrived prefilled — decode only
                     tp0 = time.perf_counter_ns()
                     if seq.prefix_len:
                         # forked chain: cow-split the divergence block if
@@ -413,10 +599,13 @@ class ServingEngine:
                             seq.prompt, table, seq.prefix_len)
                         g_serving_prefill_tokens.put(
                             len(seq.prompt) - seq.prefix_len)
+                        self.prefill_tokens += (len(seq.prompt)
+                                                - seq.prefix_len)
                     else:
                         table = self.kv.block_table(seq.seq_id)
                         first = self.model.prefill(seq.prompt, table)
                         g_serving_prefill_tokens.put(len(seq.prompt))
+                        self.prefill_tokens += len(seq.prompt)
                     self._append_token(seq, first)
                     span = getattr(seq.cntl, "span", None)
                     if span is not None:
@@ -426,6 +615,12 @@ class ServingEngine:
             finally:
                 _prof.set_phase(prev)
         self._reap_finished()
+        # ---- disaggregated handoff: a prefill-role engine ships every
+        # live chain to the decode shard right after its first token; a
+        # failed migration leaves the sequence here (local-decode
+        # fallback), retried next step
+        if self.config.role == ROLE_PREFILL and self.migrator is not None:
+            self._migrate_handoff()
         # ---- decode phase: ONE fused program for the whole batch
         batch = list(self._running)
         if batch:
@@ -499,8 +694,12 @@ class ServingEngine:
         if not seq.out_tokens:
             seq.t_first_token = now
             g_serving_ttft.record((now - seq.t_submit) * 1e6)
+            if len(self.ttft_samples) < 65536:
+                self.ttft_samples.append((now - seq.t_submit) * 1e6)
         elif seq.t_last_token:
             g_serving_itl.record((now - seq.t_last_token) * 1e6)
+            if len(self.itl_samples) < 65536:
+                self.itl_samples.append((now - seq.t_last_token) * 1e6)
         seq.t_last_token = now
         seq.out_tokens.append(tok)
         self.tokens_generated += 1
@@ -543,6 +742,71 @@ class ServingEngine:
                 still.append(seq)
         self._running = still
 
+    # ------------------------------------------------------------- handoff
+    def _migrate_handoff(self) -> None:
+        """Ship every live chain to the decode shard (runs on the engine
+        thread between phases, so each sequence is quiescent). Successes
+        complete the stage-1 RPC with the handoff meta; failures stay in
+        the running set and decode locally."""
+        moved = []
+        for seq in list(self._running):
+            if seq.state == STATE_DONE or seq.adopted:
+                continue
+            dest = self.migrator.migrate(seq, self.kv)
+            if dest is not None:
+                moved.append((seq, dest))
+        if not moved:
+            return
+        gone = {id(s) for s, _ in moved}
+        self._running = [s for s in self._running if id(s) not in gone]
+        for seq, dest in moved:
+            self._finish_handoff(seq, dest)
+
+    def _finish_handoff(self, seq: Sequence, dest_seq_id: int) -> None:
+        """Complete the stage-1 RPC: the reply's meta (finish_reason
+        "handoff" + handoff_shard + the adopted seq_id) tells the client
+        where its generation keeps running. The chain was released by
+        the migrator on the destination's ACK — nothing to free here."""
+        from brpc_tpu.proto import serving_pb2
+
+        seq.state = STATE_DONE
+        seq.finish_reason = "handoff"
+        self._stream_delta(seq, [], True)  # stage-1 stream is complete
+        done, seq.done = seq.done, None
+        if done is None:
+            return
+        ttft_us = 0
+        if seq.t_first_token:
+            ttft_us = int((seq.t_first_token - seq.t_submit) * 1e6)
+        resp = serving_pb2.GenerateResponse(
+            tokens=seq.out_tokens, seq_id=dest_seq_id,
+            prompt_len=len(seq.prompt), steps=len(seq.out_tokens),
+            ttft_us=ttft_us, finish_reason="handoff",
+            handoff_shard=self.migrator.dest_shard)
+        try:
+            done(resp)
+        except Exception:
+            pass
+
+    def _drain_migrate(self) -> None:
+        """stop()-path recovery: move live chains to the survivor. The
+        client RPC still fails retriably (its engine IS going away), but
+        the retry attaches to the migrated sequence on the destination —
+        zero re-prefilled tokens."""
+        with self._cv:
+            live = [s for s in self._running
+                    if s.state != STATE_DONE and not s.adopted]
+        for seq in live:
+            dest = self.migrator.migrate(seq, self.kv, recovery=True)
+            if dest is None:
+                continue  # the abort fan below will clean it up
+            with self._cv:
+                if seq in self._running:
+                    self._running.remove(seq)
+            self._finish(seq, errors.EFAILEDSOCKET,
+                         "shard draining: sequence migrated to survivor "
+                         "(retriable)")
+
     def _finish(self, seq: Sequence, code: int, reason: str) -> None:
         if code == 0 and self.prefix is not None and seq.out_tokens:
             # commit the fully-written blocks back into the radix tree
@@ -563,6 +827,13 @@ class ServingEngine:
 
             stream_close(seq.stream_id)
             seq.stream_id = 0
+        with self._cv:
+            if seq.adopted and seq.done is None and not seq._attached:
+                # migrated-in with no client yet: park the result for
+                # the stage-2/retry attach (blocks already freed above)
+                seq._deferred = (code, reason)
+                return
+            self._adopted.pop(seq.seq_id, None)
         done, seq.done = seq.done, None
         if done is None:
             return
@@ -581,16 +852,23 @@ class ServingEngine:
         ttft_us = 0
         if seq.t_first_token:
             ttft_us = int((seq.t_first_token - seq.t_submit) * 1e6)
+        # a resume (stage-2) attach already received the prefill shard's
+        # tokens in the stage-1 reply — return only the suffix decoded
+        # here; a recovery attach replaces the lost reply entirely
+        toks = (seq.out_tokens[seq.handoff_base:] if seq.resume_attach
+                else seq.out_tokens)
         return serving_pb2.GenerateResponse(
-            tokens=seq.out_tokens, seq_id=seq.seq_id,
-            prompt_len=len(seq.prompt), steps=len(seq.out_tokens),
+            tokens=toks, seq_id=seq.seq_id,
+            prompt_len=len(seq.prompt), steps=len(toks),
             ttft_us=ttft_us, finish_reason=seq.finish_reason or "length")
 
     def _abort_all_locked_out(self, code: int, reason: str) -> None:
         with self._cv:
-            pending = list(self._waiting) + list(self._running)
+            pending = (list(self._waiting) + list(self._running)
+                       + list(self._adopted_pending))
             self._waiting.clear()
             self._running = []
+            self._adopted_pending.clear()
         for seq in pending:
             self._finish(seq, code, reason)
 
@@ -598,7 +876,16 @@ class ServingEngine:
     def snapshot(self) -> Dict[str, object]:
         kv = self.kv.snapshot()
         occ = (self._occupancy_sum / self.steps) if self.steps else 0.0
+        migration = None
+        if self.migrator is not None or self._migration_rx is not None:
+            migration = {"parked": len(self._adopted)}
+            if self.migrator is not None:
+                migration["out"] = self.migrator.snapshot()
+            if self._migration_rx is not None:
+                migration["in"] = self._migration_rx.snapshot()
         return {
+            "role": self.config.role,
+            "migration": migration,
             "scheduling": self.config.scheduling,
             "max_batch": self.config.max_batch,
             "token_budget": self.config.token_budget,

@@ -130,6 +130,10 @@ class PagedKVCache:
         # sharded pools are ledger-only; their owner installs the device
         # copy used by cow_block against the stacked per-mesh pools
         self._cow_copy_fn = None
+        # sequences audited + frozen for export (migration); any write
+        # (extend/cow) clears the mark, so export_chain can only see a
+        # chain with no in-flight mutations since its quiesce
+        self._quiesced: set = set()
         self._check = False
         try:
             from brpc_tpu.analysis import runtime_check
@@ -225,6 +229,7 @@ class PagedKVCache:
                 table.append(self._take_block_locked())
                 grew += 1
             self._seq_len[seq_id] = new_len
+            self._quiesced.discard(seq_id)
             self._audit_locked()
         if grew:
             g_serving_kv_block_allocs.put(grew)
@@ -328,6 +333,7 @@ class PagedKVCache:
                 raise IndexError(f"block index {block_index} outside "
                                  f"table of {len(table)}")
             src = table[block_index]
+            self._quiesced.discard(seq_id)  # a write is coming
             if self._ref.get(src, 0) == 1:
                 return src  # exclusive already — no split needed
             dst = self._take_block_locked()
@@ -390,6 +396,56 @@ class PagedKVCache:
         for t, p in zip(tables, positions):
             self.assert_writable(t, int(p), int(p) + 1)
 
+    # ------------------------------------------------------------ migration
+    def quiesce_sequence(self, seq_id: int) -> int:
+        """Freeze a sequence for export: re-audit the ledger and mark the
+        chain quiesced. Any subsequent write (extend/cow) clears the mark,
+        so :meth:`export_chain` can never serialize a chain with in-flight
+        writes or un-audited refcounts. Returns the chain length covered
+        (tokens). The engine calls this only once the step loop has no
+        launch outstanding for the sequence."""
+        with self._lock:
+            if seq_id not in self._tables:
+                raise KeyError(f"unknown sequence {seq_id}")
+            # force the audit even on disarmed ledgers — exporting a chain
+            # whose refcounts disagree with the tables ships corruption
+            problems = self._invariant_problems_locked()
+            if problems:
+                raise AssertionError(
+                    "refusing to quiesce over a broken ledger: " +
+                    "; ".join(problems))
+            self._quiesced.add(seq_id)
+            return self._seq_len[seq_id]
+
+    def export_chain(self, seq_id: int) -> Tuple[List[int], int]:
+        """Snapshot a quiesced sequence's (block table, ntokens) for
+        migration. The chain stays owned by the source until
+        :meth:`release_exported` — the destination ACK is what moves
+        ownership, so there is no window where the blocks belong to
+        nobody (or to both sides)."""
+        with self._lock:
+            if seq_id not in self._tables:
+                raise KeyError(f"unknown sequence {seq_id}")
+            if seq_id not in self._quiesced:
+                raise AssertionError(
+                    f"export of sequence {seq_id} without quiesce: call "
+                    f"quiesce_sequence first (no in-flight writes may be "
+                    f"outstanding when a chain leaves the pool)")
+            return list(self._tables[seq_id]), self._seq_len[seq_id]
+
+    def release_exported(self, seq_id: int) -> int:
+        """Drop the source's ownership of a migrated chain after the
+        destination ACKed adoption. Returns blocks freed."""
+        with self._lock:
+            self._quiesced.discard(seq_id)
+        return self.free_sequence(seq_id)
+
+    def unquiesce_sequence(self, seq_id: int) -> None:
+        """Abort an export (migration failed): the chain stays local and
+        writable again."""
+        with self._lock:
+            self._quiesced.discard(seq_id)
+
     def free_sequence(self, seq_id: int) -> int:
         """Drop a sequence's table; blocks return to the free list when
         their refcount hits zero. Returns blocks actually freed."""
@@ -397,6 +453,7 @@ class PagedKVCache:
         with self._lock:
             table = self._tables.pop(seq_id, None)
             self._seq_len.pop(seq_id, None)
+            self._quiesced.discard(seq_id)
             if table is None:
                 return 0
             for b in table:
@@ -707,6 +764,50 @@ class ShardedKVCache:
         if shard is None:
             return 0
         return self.pools[shard].free_sequence(seq_id)
+
+    def adopt_sequence(self, seq_id: int, blocks, ntokens: int,
+                       shard: Optional[int] = None) -> ShardTable:
+        """Register a sequence over an existing live chain on ``shard``
+        (migration staging adopt): refcount++ on every chain block, no
+        allocation. Defaults to the chain's own shard when ``blocks`` is
+        a :class:`ShardTable`."""
+        if shard is None:
+            shard = getattr(blocks, "shard", None)
+        if shard is None:
+            raise ValueError("adopt_sequence on a sharded pool needs the "
+                             "owning shard (ShardTable or shard=)")
+        table = self.pools[shard].adopt_sequence(seq_id, list(blocks),
+                                                 ntokens)
+        with self._lock:
+            self._shard_of[seq_id] = shard
+        return ShardTable(shard, table)
+
+    # ------------------------------------------------------------ migration
+    def quiesce_sequence(self, seq_id: int) -> int:
+        got = self._pool_of(seq_id)
+        if got is None:
+            raise KeyError(f"unknown sequence {seq_id}")
+        return got[1].quiesce_sequence(seq_id)
+
+    def export_chain(self, seq_id: int) -> Tuple[ShardTable, int]:
+        got = self._pool_of(seq_id)
+        if got is None:
+            raise KeyError(f"unknown sequence {seq_id}")
+        shard, pool = got
+        blocks, ntokens = pool.export_chain(seq_id)
+        return ShardTable(shard, blocks), ntokens
+
+    def release_exported(self, seq_id: int) -> int:
+        with self._lock:
+            shard = self._shard_of.pop(seq_id, None)
+        if shard is None:
+            return 0
+        return self.pools[shard].release_exported(seq_id)
+
+    def unquiesce_sequence(self, seq_id: int) -> None:
+        got = self._pool_of(seq_id)
+        if got is not None:
+            got[1].unquiesce_sequence(seq_id)
 
     # -------------------------------------------------------- copy-on-write
     def cow_block(self, seq_id: int, block_index: int) -> int:

@@ -80,23 +80,36 @@ class GenerateRouter(CallMapper):
     (first cached block of token ids) so same-prefix traffic lands on the
     shard that holds the cached chain."""
 
-    def __init__(self, partition_count: int, block_size: int = 0):
+    def __init__(self, partition_count: int, block_size: int = 0,
+                 prefill_partitions: Optional[list] = None):
         self.partition_count = partition_count
         self.block_size = block_size
+        # disaggregated two-stage dispatch: stage-1 Generates (fresh
+        # prompts) spread over the prefill partitions only; stage-2
+        # (resume_seq_id set) go to the decode shard the handoff named
+        self.prefill_partitions = (list(prefill_partitions)
+                                   if prefill_partitions else None)
 
     def route_key(self, request) -> int:
         if self.block_size:
             return _request_route_key(request, self.block_size)
         return generate_route_key(request)
 
+    def owner_of(self, request) -> int:
+        from brpc_tpu.shard.plane import shard_for
+
+        if getattr(request, "resume_seq_id", 0):
+            return int(request.resume_shard)
+        if self.prefill_partitions is not None:
+            return self.prefill_partitions[
+                shard_for(self.route_key(request),
+                          len(self.prefill_partitions))]
+        return shard_for(self.route_key(request), self.partition_count)
+
     def map(self, channel_index: int, method: MethodDescriptor,
             request, response) -> object:
         if method.method_name == "Generate":
-            from brpc_tpu.shard.plane import shard_for
-
-            owner = shard_for(self.route_key(request),
-                              self.partition_count)
-            if channel_index != owner:
+            if channel_index != self.owner_of(request):
                 return SKIP
         return SubCall(method, request,
                        method.response_class() if method.response_class
@@ -138,10 +151,12 @@ class ShardedLlmChannel:
     def __init__(self, ns_url: str, partition_count: int,
                  options: Optional[ChannelOptions] = None,
                  parser: Optional[PartitionParser] = None,
-                 block_size: int = 0):
+                 block_size: int = 0,
+                 prefill_partitions: Optional[list] = None):
         self.partition_count = partition_count
         self._router = GenerateRouter(partition_count,
-                                      block_size=block_size)
+                                      block_size=block_size,
+                                      prefill_partitions=prefill_partitions)
         self._pc = PartitionChannel(fail_limit=1)
         self._pc.init(ns_url, partition_count, parser=parser,
                       options=options,
@@ -149,17 +164,9 @@ class ShardedLlmChannel:
                       response_merger=StatsMerger())
 
     def shard_of(self, request) -> int:
-        from brpc_tpu.shard.plane import shard_for
+        return self._router.owner_of(request)
 
-        return shard_for(self._router.route_key(request),
-                         self.partition_count)
-
-    def generate(self, request,
-                 controller: Optional[Controller] = None,
-                 timeout_ms: Optional[float] = None):
-        cntl = controller or Controller()
-        if timeout_ms is not None:
-            cntl.timeout_ms = timeout_ms
+    def _call_generate(self, request, cntl):
         try:
             return self._pc.call_method(GENERATE_MD, request,
                                         controller=cntl)
@@ -173,6 +180,49 @@ class ShardedLlmChannel:
                 f"shard {self.shard_of(request)}/{self.partition_count} "
                 f"failed mid-generate (retriable): {detail}")
             raise RpcError(cntl)
+
+    def generate(self, request,
+                 controller: Optional[Controller] = None,
+                 timeout_ms: Optional[float] = None,
+                 stream_factory=None):
+        """One logical generation, any number of physical hops.
+
+        On a disaggregated fleet the prefill shard answers with
+        ``finish_reason == "handoff"`` and names the decode shard that
+        adopted the sequence (``handoff_shard``/``seq_id``); this follows
+        the handoff with a stage-2 resume call and stitches the two
+        replies into the response a co-located fleet would have returned:
+        tokens concatenated, prompt_len/ttft from the prefill stage,
+        steps summed, seq_id/finish_reason from the decode stage.
+        ``stream_factory()`` (optional) supplies a fresh stream id per
+        hop so TokenDelta frames keep flowing across the handoff."""
+        cntl = controller or Controller()
+        if timeout_ms is not None:
+            cntl.timeout_ms = timeout_ms
+        resp = self._call_generate(request, cntl)
+        hops = 0
+        while (resp is not None and resp.finish_reason == "handoff"
+               and hops < 4):
+            hops += 1
+            follow = serving_pb2.GenerateRequest(
+                resume_seq_id=resp.seq_id,
+                resume_shard=resp.handoff_shard)
+            cntl2 = Controller()
+            if timeout_ms is not None:
+                cntl2.timeout_ms = timeout_ms
+            if stream_factory is not None:
+                cntl2.stream_id = stream_factory()
+            stage2 = self._call_generate(follow, cntl2)
+            stitched = serving_pb2.GenerateResponse(
+                tokens=list(resp.tokens) + list(stage2.tokens),
+                seq_id=stage2.seq_id,
+                prompt_len=resp.prompt_len,
+                steps=resp.steps + stage2.steps,
+                ttft_us=resp.ttft_us,
+                finish_reason=stage2.finish_reason,
+                handoff_shard=stage2.handoff_shard)
+            resp = stitched
+        return resp
 
     def stats(self, controller: Optional[Controller] = None):
         return self._pc.call_method(

@@ -42,8 +42,27 @@ class LlmServingService(Service):
     def __init__(self, engine: ServingEngine):
         super().__init__()
         self.engine = engine
+        # inbound KV migration (disaggregated decode side / shard-death
+        # survivor); built lazily so co-located deployments never touch
+        # the migration module
+        self._receiver = None
 
     def Generate(self, cntl, request, done):
+        if request.resume_seq_id:
+            # stage-2 of the disaggregated dispatch: attach to the
+            # migrated sequence (no prompt, no admission, no allocation)
+            stream_id = 0
+            meta = getattr(cntl, "_srv_meta", None)
+            if meta is not None and meta.stream_settings.stream_id:
+                stream_id = stream_accept(cntl, StreamOptions())
+            code, _seq = self.engine.submit(
+                np.zeros(0, dtype=np.int32), 0, cntl=cntl, done=done,
+                stream_id=stream_id,
+                resume_seq_id=request.resume_seq_id)
+            if code != 0:
+                cntl.set_failed(code, "no such migrated sequence")
+                return serving_pb2.GenerateResponse()
+            return None  # async: completion comes from the step loop
         if request.prompt_tokens:
             prompt = np.asarray(request.prompt_tokens, dtype=np.int32)
         elif request.prompt_len > 0:
@@ -64,6 +83,27 @@ class LlmServingService(Service):
             cntl.set_failed(code, "serving admission rejected")
             return serving_pb2.GenerateResponse()
         return None  # async: the engine's step loop calls done()
+
+    def _migration_receiver(self):
+        if self._receiver is None:
+            from brpc_tpu.serving.migration import MigrationReceiver
+
+            self._receiver = MigrationReceiver(self.engine)
+            self.engine._migration_rx = self._receiver
+        return self._receiver
+
+    def MigrateOpen(self, cntl, request, done):
+        """Inbound KV migration, phase 1: validate the manifest, stage a
+        block chain, accept the caller's record stream. Synchronous —
+        the reply only says "start streaming"."""
+        return self._migration_receiver().open(cntl, request)
+
+    def MigrateCommit(self, cntl, request, done):
+        """Inbound KV migration, phase 2: block until every block is
+        consumed and the sequence adopted (or the transfer failed /
+        timed out). The reply IS the adoption ACK the source releases
+        its chain on."""
+        return self._migration_receiver().commit(cntl, request)
 
     def Stats(self, cntl, request, done):
         e = self.engine

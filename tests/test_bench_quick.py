@@ -169,12 +169,13 @@ def serving_bench_run():
 
 
 def test_serving_lane_json_metrics(serving_bench_run):
-    """The serving phase emits exactly its seven machine-readable lines:
+    """The serving phase emits exactly its ten machine-readable lines:
     streamed tokens/sec, TTFT percentiles measured at stream-frame
     arrival, the continuous-vs-static scheduling ratio (sharded stack),
     the sharded engine's tokens/sec, the prefix-cache hit-TTFT A/B pair,
-    and the coalesced device dispatch rate vs the BENCH_r05
-    isolated-dispatch baseline."""
+    the disaggregated prefill/decode interference A/B pair plus the
+    migration lane's GB/s, and the coalesced device dispatch rate vs the
+    BENCH_r05 isolated-dispatch baseline."""
     rows = [json.loads(l) for l in serving_bench_run.stdout.splitlines()
             if l.startswith("{")]
     by = {r["metric"]: r for r in rows}
@@ -183,6 +184,9 @@ def test_serving_lane_json_metrics(serving_bench_run):
                        "serving_sharded_tokens_per_s",
                        "serving_prefix_hit_ttft_ms",
                        "serving_prefix_hit_ratio",
+                       "serving_disagg_decode_jitter",
+                       "serving_disagg_ttft_ms",
+                       "serving_migrate_gbps",
                        "device_op_rate"}, \
         serving_bench_run.stdout
     assert by["serving_tokens_per_sec"]["unit"] == "tokens/s"
@@ -240,6 +244,30 @@ def test_serving_prefix_hit_ttft_floor(serving_bench_run):
     lane = [l for l in serving_bench_run.stderr.splitlines()
             if l.startswith("# serving prefix:")]
     assert lane and "OK 0.5x ceiling" in lane[0], \
+        serving_bench_run.stderr[-2000:]
+
+
+def test_serving_disagg_interference_floor(serving_bench_run):
+    """The disaggregation acceptance floor: on the 3:1 mixed corpus the
+    decode engine of the disaggregated pair must show strictly less
+    inter-token jitter (p99-p50 ITL) than the co-located engine whose
+    decode steps share a loop with the long prefill launches — and the
+    migration lane must have actually moved bytes (GB/s > 0)."""
+    rows = [json.loads(l) for l in serving_bench_run.stdout.splitlines()
+            if l.startswith("{")]
+    jit = [r for r in rows
+           if r["metric"] == "serving_disagg_decode_jitter"][0]
+    assert jit["unit"] == "ms", jit
+    assert jit["coloc_ms"] > 0, jit
+    assert jit["value"] < jit["coloc_ms"], jit
+    ttft = [r for r in rows if r["metric"] == "serving_disagg_ttft_ms"][0]
+    assert ttft["value"] > 0 and ttft["coloc_ms"] > 0, ttft
+    gbps = [r for r in rows if r["metric"] == "serving_migrate_gbps"][0]
+    assert gbps["unit"] == "GB/s" and gbps["value"] > 0, gbps
+    assert gbps["seqs"] > 0 and gbps["blocks"] > 0, gbps
+    lane = [l for l in serving_bench_run.stderr.splitlines()
+            if l.startswith("# serving disagg:")]
+    assert lane and "OK interference floor" in lane[0], \
         serving_bench_run.stderr[-2000:]
 
 

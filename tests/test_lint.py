@@ -18,7 +18,7 @@ EXPECTED_RULES = {
     "lock-order", "version-guard", "metric-flag-hygiene", "bounded-spin",
     "named-thread", "cross-process-ownership", "metric-churn",
     "no-per-token-host-sync", "no-per-op-step-dispatch",
-    "cow-before-write",
+    "cow-before-write", "quiesce-before-migrate",
 }
 
 
@@ -953,6 +953,54 @@ class TestCowBeforeWrite:
         res = _lint(tmp_path, {"serving/debug.py": """\
             def poke(self, k, v):
                 self.kv.update_pools(k, v)  # tpulint: disable=cow-before-write
+            """}, rules=self.RULE)
+        assert res.clean
+        assert len(res.suppressed) == 1
+
+
+class TestQuiesceBeforeMigrate:
+    RULE = ["quiesce-before-migrate"]
+
+    def test_bare_export_fires(self, tmp_path):
+        res = _lint(tmp_path, {"serving/migration.py": """\
+            def migrate(self, seq, kv):
+                table, ntokens = kv.export_chain(seq.seq_id)
+                self._stream(table)
+            """}, rules=self.RULE)
+        assert [f.rule for f in res.findings] == ["quiesce-before-migrate"]
+        assert res.findings[0].line == 2
+        assert "quiesce" in res.findings[0].message
+
+    def test_quiesce_guard_passes(self, tmp_path):
+        # the house contract: audit + mark read-only before the chain
+        # leaves the shard
+        res = _lint(tmp_path, {"serving/migration.py": """\
+            def migrate(self, seq, kv):
+                kv.quiesce_sequence(seq.seq_id)
+                table, ntokens = kv.export_chain(seq.seq_id)
+                self._stream(table)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_export_named_function_exempt(self, tmp_path):
+        # the quiesce/export implementations themselves ARE the contract
+        res = _lint(tmp_path, {"serving/kv_cache.py": """\
+            def export_chain(self, seq_id):
+                return self.pools[0].export_chain(seq_id)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_same_code_outside_scope_passes(self, tmp_path):
+        res = _lint(tmp_path, {"tools/debug_dump.py": """\
+            def dump(self, seq, kv):
+                return kv.export_chain(seq.seq_id)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_suppression_honored(self, tmp_path):
+        res = _lint(tmp_path, {"serving/debug.py": """\
+            def peek(self, seq, kv):
+                return kv.export_chain(seq.seq_id)  # tpulint: disable=quiesce-before-migrate
             """}, rules=self.RULE)
         assert res.clean
         assert len(res.suppressed) == 1
