@@ -21,10 +21,11 @@ Channel -> tpu:// transport -> Server stack, vs the reference's 2.3 GB/s
 loopback plateau (/root/reference/docs/cn/benchmark.md:104).
 
 Env knobs: BENCH_QUICK=1 shortens every phase (CI smoke); BENCH_SKIP_DEVICE=1
-skips the jax probe; BENCH_PHASES=shm,qps,native,hybrid,batch,device runs
-only the named phases (default: all) — e.g. BENCH_PHASES=shm is the CPU-only
-tier-1 smoke lane, whose headline is then the Python tpu:// sweep; batch is
-the adaptive-batching vs per-request dispatch comparison (also CPU-only).
+skips the jax probe; BENCH_PHASES=shm,qps,native,hybrid,batch,serving,spec,
+device runs only the named phases (default: all) — e.g. BENCH_PHASES=shm is
+the CPU-only tier-1 smoke lane, whose headline is then the Python tpu://
+sweep; batch is the adaptive-batching vs per-request dispatch comparison
+(also CPU-only); spec is the speculative-decoding draft+verify A/B.
 """
 
 from __future__ import annotations
@@ -877,6 +878,143 @@ def bench_serving_lane():
     return ratio
 
 
+def bench_spec_lane():
+    """Speculative decoding A/B: two identical engines — one plain
+    (spec_k=0), one running the prompt-lookup draft + one fused verify
+    lane (spec_k=4) — driven with the same repetition-heavy corpus the
+    committed spec replay corpus records (templated motif prompts whose
+    greedy continuations the n-gram matcher predicts). Greedy acceptance
+    makes the lanes bit-identical (raised on here, gated exactly in
+    tests/test_serving_spec.py), so the only delta is steps: the spec
+    lane commits up to k+1 tokens per fused launch. Emits tokens/s for
+    both lanes (1.3x floor), the run's accept rate, and the per-user
+    decode latency (request wall minus TTFT over tokens after the first
+    — the per-token latency one client observes)."""
+    import numpy as np
+
+    from brpc_tpu.serving import (EngineConfig, KVCacheConfig, ModelConfig,
+                                  PagedKVCache, ServingEngine,
+                                  TinyTransformer)
+    from tools.record_serving_corpus_spec import SCHEDULE, SPEC_K, spec_prompt
+
+    # no QUICK trim — doubled instead: the 8-request schedule is only
+    # ~256 decode tokens, and a pass that short puts OS-scheduler noise
+    # on the same scale as the A/B delta; 16 requests keep a pass in the
+    # hundreds of milliseconds, and the longer generations amortize the
+    # prefill share out of the tokens/s ratio
+    sched = SCHEDULE * 2
+    n_tokens = sum(mn for _, mn, _ in sched)
+    cfg = ModelConfig(vocab=256, d_model=32, n_heads=2, n_layers=2)
+
+    def build(spec_k):
+        kv = PagedKVCache(KVCacheConfig(block_size=16, num_blocks=256),
+                          cfg.n_layers, cfg.kv_dim)
+        model = TinyTransformer(cfg, kv)
+        # prefix_cache off: repeated warmups of the same motif prompts
+        # would otherwise fold prefill into the A/B, which is about the
+        # decode loop only. max_batch=1: speculation's win is fewer
+        # LAUNCHES per committed token, so the A/B runs where launch
+        # overhead dominates — a verify over k+1 rows costs ~one decode
+        # dispatch but commits up to k+1 tokens; at large batch the CPU
+        # sim's row compute scales linearly and hides exactly the
+        # dispatch overhead a real accelerator step is bound by (the
+        # batched-throughput story is the serving phase's A/B)
+        return ServingEngine(model, kv, EngineConfig(
+            max_batch=1, token_budget=512, idle_wait_s=0.002,
+            spec_k=spec_k), prefix_cache=False).start()
+
+    def run(engine, itls=None):
+        """One open-loop pass over the schedule; returns (wall_s, outputs)
+        and appends per-request mean decode ITL seconds to ``itls``."""
+        pend = []
+        t0 = time.perf_counter()
+        for plen, max_new, motif in sched:
+            ev = threading.Event()
+            box = {}
+            code, _ = engine.submit(
+                np.asarray(spec_prompt(plen, motif), dtype=np.int32),
+                max_new,
+                done=lambda r, box=box, ev=ev: (box.update(r=r,
+                                                           t=time.perf_counter()),
+                                                ev.set()))
+            if code != 0:
+                raise RuntimeError(f"spec bench submit rejected: {code}")
+            pend.append((ev, box))
+        outs = []
+        for ev, box in pend:
+            if not ev.wait(300):
+                raise RuntimeError("spec bench stalled")
+            r = box["r"]
+            outs.append(list(r.tokens))
+            if itls is not None and len(r.tokens) > 1:
+                decode_s = (box["t"] - t0) - r.ttft_us / 1e6
+                itls.append(max(0.0, decode_s) / (len(r.tokens) - 1))
+        return time.perf_counter() - t0, outs
+
+    REPS = 5  # best-of: one GC pause must not flip the A/B
+    base = build(0)
+    sp = build(SPEC_K)
+    try:
+        for _ in range(2):  # compile every bucket (2nd donated signature)
+            run(base)
+            run(sp)
+        base_wall, base_itl = float("inf"), []
+        sp_wall, sp_itl = float("inf"), []
+        base_outs = sp_outs = None
+        for _ in range(REPS):
+            w, base_outs = run(base, base_itl)
+            base_wall = min(base_wall, w)
+            w, sp_outs = run(sp, sp_itl)
+            sp_wall = min(sp_wall, w)
+        if sp_outs != base_outs:
+            raise RuntimeError(
+                "speculative lane diverged from baseline: greedy "
+                "acceptance must be bit-identical")
+        st = sp.spec_stats.snapshot()
+    finally:
+        sp.stop()
+        base.stop()
+        sp.model.close()
+        base.model.close()
+    tps = n_tokens / sp_wall
+    base_tps = n_tokens / base_wall
+    ratio = tps / max(base_tps, 1e-9)
+    itl_ms = 1e3 * sorted(sp_itl)[len(sp_itl) // 2] if sp_itl else 0.0
+    base_itl_ms = 1e3 * sorted(base_itl)[len(base_itl) // 2] \
+        if base_itl else 0.0
+    print(f"# serving spec: {len(sched)} reqs ({n_tokens} tokens) "
+          f"draft+verify k={SPEC_K}: spec={tps:,.0f} tok/s "
+          f"baseline={base_tps:,.0f} tok/s ratio={ratio:.2f}x "
+          f"({'OK' if ratio >= 1.3 else 'BELOW'} 1.3x floor) | "
+          f"accept_rate={st['accept_rate']:.2f} "
+          f"(drafted={st['drafted']} accepted={st['accepted']} "
+          f"bonus={st['bonus']}) | per-user decode itl p50 "
+          f"spec={itl_ms:.2f}ms baseline={base_itl_ms:.2f}ms",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "serving_spec_tokens_per_s",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "baseline": round(base_tps, 1),
+        "ratio": round(ratio, 3),
+    }))
+    print(json.dumps({
+        "metric": "serving_spec_accept_rate",
+        "value": st["accept_rate"],
+        "unit": "ratio",
+        "drafted": st["drafted"],
+        "accepted": st["accepted"],
+        "bonus": st["bonus"],
+    }))
+    print(json.dumps({
+        "metric": "serving_spec_itl_ms",
+        "value": round(itl_ms, 3),
+        "unit": "ms",
+        "baseline_ms": round(base_itl_ms, 3),
+    }))
+    return ratio
+
+
 def bench_native_lane():
     """The framework's native lane end to end: C++ bench client (the analog
     of the reference's C++ client binaries) against the C++ engine serving
@@ -1577,6 +1715,8 @@ def main() -> None:
         bench_batch_lane()
     if _phase_enabled("serving"):
         bench_serving_lane()
+    if _phase_enabled("spec"):
+        bench_spec_lane()
     py_1mb = py_64b_qps = series_pct = None
     if _phase_enabled("shm"):
         py_1mb, py_64b_qps = bench_tpu_sweep()

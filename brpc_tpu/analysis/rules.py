@@ -1123,3 +1123,66 @@ def rule_quiesce_before_migrate(pkg: Package) -> List[Finding]:
                     f"stream out; call kv.quiesce_sequence first and "
                     f"unquiesce on failure"))
     return out
+
+
+# --------------------------------------------------------------------------
+# Rule 16: draft-no-device-sync
+# --------------------------------------------------------------------------
+# Speculative decoding's throughput story (PR 18, docs/serving.md
+# §Speculative) rests on the draft lane being FREE on the device
+# timeline: prompt-lookup drafting runs as pure host Python over the
+# committed token history, so a step is still exactly one fused launch
+# (the verify) and one host sync, and the engine's (1,1) DispatchCounter
+# assertion keeps holding with k drafts exactly as it did with none. A
+# jax import or a jit/device-dispatch/host-sync call creeping into the
+# drafter would silently turn every step into 1+N launches — the rule
+# pins the whole module host-side at lint time, where the runtime audit
+# only sees paths tests exercise.
+
+_DRAFT_SCOPE = {"serving/speculative.py"}
+_DRAFT_DEVICE_CALLS = {"jit", "device_put", "device_get",
+                       "block_until_ready", "pmap", "shard_map"}
+
+
+@register_rule(
+    "draft-no-device-sync",
+    "the speculative draft lane (serving/speculative.py) must stay "
+    "host-side: no jax imports, no jit/device dispatch, no host-sync "
+    "primitives — drafting rides the step's single verify launch")
+def rule_draft_no_device_sync(pkg: Package) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in pkg.files:
+        if not in_scope(sf.rel, _DRAFT_SCOPE):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    root = alias.name.split(".")[0]
+                    if root == "jax":
+                        out.append(Finding(
+                            "draft-no-device-sync", sf.rel, node.lineno,
+                            f"draft-lane module imports {alias.name!r} — "
+                            f"drafting must stay host-side (zero device "
+                            f"work before the one fused verify launch)"))
+            elif isinstance(node, ast.ImportFrom):
+                root = (node.module or "").split(".")[0]
+                if root == "jax":
+                    out.append(Finding(
+                        "draft-no-device-sync", sf.rel, node.lineno,
+                        f"draft-lane module imports from "
+                        f"{node.module!r} — drafting must stay "
+                        f"host-side"))
+            elif isinstance(node, ast.Call):
+                name = attr_chain(node.func)
+                if name is None:
+                    continue
+                parts = name.split(".")
+                if parts[0] == "jax" or parts[-1] in _DRAFT_DEVICE_CALLS:
+                    out.append(Finding(
+                        "draft-no-device-sync", sf.rel, node.lineno,
+                        f"{name}() dispatches device work or forces a "
+                        f"host sync inside the draft lane — the step "
+                        f"contract is ONE launch (the fused verify) and "
+                        f"ONE sync; draft from the committed host-side "
+                        f"history instead"))
+    return out

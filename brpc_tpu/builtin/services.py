@@ -678,6 +678,15 @@ def serving_service(server, http: HttpMessage):
                 f"evicted={pfx['evicted_blocks']} "
                 f"hit_ratio={pfx['hit_ratio']:.2f}"
                 + ("" if pfx.get("enabled", True) else " (disabled)"))
+        # speculative decoding: draft/verify economics — how many tokens
+        # each verify launch commits and how many rows it wastes
+        sp = s.get("spec")
+        if sp:
+            out.append(
+                f"  spec: k_max={sp['k_max']} drafted={sp['drafted']} "
+                f"accepted={sp['accepted']} rejected={sp['rejected']} "
+                f"bonus={sp['bonus']} accept_rate={sp['accept_rate']:.2f} "
+                f"collapsed_seqs={sp['collapsed_seqs']}")
         # disaggregated serving: outbound handoff counters on prefill
         # engines, inbound adoption counters on decode engines, plus the
         # parked (adopted-not-yet-attached) sequence count

@@ -303,6 +303,13 @@ serving_migrate_timeout_ms = define(
     "source retains the chain, falling back to local decode) if the "
     "destination has not adopted every block within this bound",
     validator=_positive)
+serving_spec_accept_rate_min = define(
+    "serving_spec_accept_rate_min", 0.2,
+    "serving_spec_collapse watch rule fires when the speculative-decode "
+    "accept rate (accepted/drafted over recent steps) sustains below "
+    "this bound — drafts are being rejected wholesale and the verify "
+    "rows are wasted compute (reloadable: the rule reads the flag at "
+    "every tick)", validator=lambda v: 0.0 < float(v) <= 1.0)
 serving_migrate_backlog_max = define(
     "serving_migrate_backlog_max", 8.0,
     "serving_migrate_backlog watch rule fires when more than this many "

@@ -296,3 +296,14 @@ def install_default_rules() -> None:
         "serving_migrate_backlog", "g_serving_migrate_inflight",
         KIND_THRESHOLD, ">", 8, window_s=10, for_ticks=2, clear_ticks=5,
         value_fn=lambda: _flags.get("serving_migrate_backlog_max")))
+    # speculative decoding: the accept-rate gauge sliding below the
+    # bound means prompt-lookup drafts stopped matching the model's
+    # output — every verify row past the first is wasted compute. The
+    # per-sequence AdaptiveK guard collapses offenders to plain decode;
+    # this rule surfaces a FLEET-wide collapse (workload shift,
+    # misdraft-shaped bug) the per-sequence guard can only mask. Bound
+    # is the reloadable serving_spec_accept_rate_min flag
+    w.add(WatchRule(
+        "serving_spec_collapse", "g_serving_spec_accept_rate",
+        KIND_THRESHOLD, "<", 0.2, window_s=10, for_ticks=2, clear_ticks=5,
+        value_fn=lambda: _flags.get("serving_spec_accept_rate_min")))

@@ -36,6 +36,11 @@ mechanism:
   drains its live sequences onto survivors, with the paged ledger's
   quiesce/export/adopt handshake keeping block ownership single-writer
   throughout.
+- :mod:`brpc_tpu.serving.speculative` — the speculative-decoding draft
+  lane: host-side prompt-lookup drafting (zero weights, zero device
+  work, lint-pinned) feeding the model's one fused ``verify_step``
+  launch per step; greedy acceptance keeps outputs bit-identical to
+  plain decode while committing up to k+1 tokens per step.
 """
 
 from brpc_tpu.serving.kv_cache import (KVCacheConfig, PagedKVCache,
@@ -46,6 +51,8 @@ from brpc_tpu.serving.prefix_cache import (PrefixCache, ShardedPrefixCache,
                                            build_prefix_cache,
                                            prefix_route_key)
 from brpc_tpu.serving.service import LlmServingService
+from brpc_tpu.serving.speculative import (AdaptiveK, accept_longest_prefix,
+                                          draft_tokens)
 
 
 def __getattr__(name):
@@ -77,4 +84,5 @@ __all__ = [
     "prefix_route_key",
     "LlmServingService", "ShardedLlmChannel",
     "KVMigrator", "MigrationReceiver",
+    "AdaptiveK", "accept_longest_prefix", "draft_tokens",
 ]

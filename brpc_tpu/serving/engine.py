@@ -53,6 +53,7 @@ from brpc_tpu.metrics.reducer import Adder
 from brpc_tpu.metrics.status import PassiveStatus
 from brpc_tpu.profiling import registry as _prof
 from brpc_tpu.rpc import errors
+from brpc_tpu.serving import speculative as _spec
 from brpc_tpu.serving.kv_cache import KVCacheFull, PagedKVCache
 from brpc_tpu.serving.model import TinyTransformer
 
@@ -109,11 +110,15 @@ class EngineConfig:
     def __init__(self, max_batch: int = 8, token_budget: int = 512,
                  max_queue: int = 64, max_new_tokens_cap: int = 512,
                  scheduling: str = SCHED_CONTINUOUS,
-                 idle_wait_s: float = 0.05, role: str = ROLE_BOTH):
+                 idle_wait_s: float = 0.05, role: str = ROLE_BOTH,
+                 spec_k: int = 0, spec_ngram: int = 3,
+                 spec_collapse_after: int = 4):
         if scheduling not in (SCHED_CONTINUOUS, SCHED_STATIC):
             raise ValueError(f"unknown scheduling {scheduling!r}")
         if role not in (ROLE_PREFILL, ROLE_DECODE, ROLE_BOTH):
             raise ValueError(f"unknown role {role!r}")
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         self.max_batch = max_batch
         # per-step budget over prefill tokens + one decode token per
         # running sequence — the Orca iteration-level knob
@@ -128,6 +133,14 @@ class EngineConfig:
         # mostly adopts migrated sequences but still accepts fresh
         # submissions (roles are scheduling placement, not capability)
         self.role = role
+        # speculative decoding: spec_k > 0 turns each decode step into
+        # draft-k + one fused verify (serving/speculative.py); per
+        # sequence the AdaptiveK controller shrinks k on rejection and
+        # collapses to plain decode after spec_collapse_after
+        # consecutive zero-accept steps
+        self.spec_k = spec_k
+        self.spec_ngram = spec_ngram
+        self.spec_collapse_after = spec_collapse_after
 
 
 STATE_WAITING = "waiting"
@@ -172,6 +185,9 @@ class Sequence:
         self._attached = False
         self._deferred: Optional[tuple] = None
         self.t_adopted = 0.0
+        # speculative decoding: per-sequence adaptive draft-length
+        # controller, created lazily by the engine when spec_k > 0
+        self.spec = None
 
     @property
     def pos(self) -> int:
@@ -225,6 +241,10 @@ class ServingEngine:
         self.prefill_tokens = 0
         self.ttft_samples: List[float] = []  # us, bounded
         self.itl_samples: List[float] = []   # us, bounded
+        # speculative decoding: per-engine counters (the A/B bench and
+        # the oracle need per-lane isolation, like the fields above)
+        self.spec_stats = (_spec.SpecStats()
+                           if self.config.spec_k > 0 else None)
         # per-shard decode attribution: shard -> [steps, total_us,
         # last_us, seq_steps] (only shards with live sequences tick)
         self._shard_step: Dict[int, List[float]] = {}
@@ -509,7 +529,11 @@ class ServingEngine:
             seq = self._adopted_pending.popleft()
             self._running.append(seq)
             admitted.append(seq)
-        budget = cfg.token_budget - len(self._running)
+        # accepted-length is variable spend: a speculating sequence can
+        # commit up to 1 + k tokens per step, so it reserves that many
+        # budget slots, not one (a collapsed sequence is back to 1)
+        budget = cfg.token_budget - sum(self._decode_cost(s)
+                                        for s in self._running)
         while (self._waiting and len(self._running) < cfg.max_batch
                and budget >= self._prefill_cost(self._waiting[0])):
             seq = self._waiting[0]
@@ -541,6 +565,14 @@ class ServingEngine:
             admitted.append(seq)
             g_serving_admitted.put(1)
         return admitted
+
+    def _decode_cost(self, seq: Sequence) -> int:
+        """Iteration-budget cost of one decode step for ``seq``: the max
+        tokens it can commit (1 + its current draft length)."""
+        if self.config.spec_k <= 0:
+            return 1
+        k = seq.spec.k if seq.spec is not None else self.config.spec_k
+        return 1 + k
 
     def _prefill_cost(self, seq: Sequence) -> int:
         """Iteration-budget cost of prefilling ``seq``: only the suffix
@@ -628,6 +660,9 @@ class ServingEngine:
             try:
                 _fault.maybe_sleep(_fault.hit("serving.decode.stall"))
                 td0 = time.perf_counter_ns()
+                cfg = self.config
+                spec_on = (cfg.spec_k > 0
+                           and hasattr(self.model, "verify_step"))
                 tokens = np.array([s.out_tokens[-1] for s in batch],
                                   dtype=np.int32)
                 # the step's input token (last sampled) is written at the
@@ -635,19 +670,48 @@ class ServingEngine:
                 # context_len() and the write position is context_len()-1
                 positions = np.array([s.pos for s in batch],
                                      dtype=np.int32)
-                tables = []
-                for s in batch:
-                    tables.append(self.kv.extend_sequence(
-                        s.seq_id, s.context_len()))
+                if spec_on:
+                    # draft lane: host-side prompt-lookup over committed
+                    # history — zero device work before the one verify
+                    # launch. k is capped at remaining-1 (a full accept
+                    # plus bonus lands exactly on max_new_tokens) so the
+                    # chain never outgrows the admitted KV bound.
+                    vocab = getattr(self.model.config, "vocab", 0)
+                    drafts = []
+                    for s in batch:
+                        if s.spec is None:
+                            s.spec = _spec.AdaptiveK(
+                                cfg.spec_k, cfg.spec_collapse_after)
+                        k = min(s.spec.k,
+                                max(0, s.max_new_tokens
+                                    - len(s.out_tokens) - 1))
+                        drafts.append(_spec.draft_tokens(
+                            list(s.prompt) + s.out_tokens, k,
+                            cfg.spec_ngram, vocab) if k > 0 else [])
+                    tables = []
+                    for s, d in zip(batch, drafts):
+                        tables.append(self.kv.extend_sequence(
+                            s.seq_id, s.context_len() + len(d)))
+                else:
+                    tables = []
+                    for s in batch:
+                        tables.append(self.kv.extend_sequence(
+                            s.seq_id, s.context_len()))
                 # dispatch-count invariant: under an armed ledger, the
-                # whole decode batch — across every mesh shard — must
-                # cost exactly ONE fused launch + ONE host sync
+                # whole decode batch — across every mesh shard, and all
+                # k+1 verify rows per sequence — must cost exactly ONE
+                # fused launch + ONE host sync
                 audit = (getattr(self.model, "FUSED_STEP", False)
                          and getattr(self.kv, "_check", False))
                 if audit:
                     from brpc_tpu.tpu.device_lane import step_dispatch
                     d_before = step_dispatch.snapshot()
-                nxt = self.model.decode_step(tokens, positions, tables)
+                if spec_on:
+                    outs = self.model.verify_step(tokens, positions,
+                                                  tables, drafts)
+                else:
+                    nxt = self.model.decode_step(tokens, positions,
+                                                 tables)
                 if audit:
                     launches, _, syncs = step_dispatch.delta(
                         d_before, step_dispatch.snapshot())
@@ -666,8 +730,12 @@ class ServingEngine:
                     st[1] += decode_us
                     st[2] = decode_us
                     st[3] += n_live
-                for s, tok in zip(batch, nxt):
-                    self._append_token(s, int(tok))
+                if spec_on:
+                    self._commit_speculative(batch, drafts, outs)
+                else:
+                    for s, tok in zip(batch, nxt):
+                        self._append_token(s, int(tok))
+                for s in batch:
                     span = getattr(s.cntl, "span", None)
                     if span is not None:
                         span.add_phase("decode_us",
@@ -675,7 +743,16 @@ class ServingEngine:
             except KVCacheFull:
                 # mid-decode exhaustion: shed the youngest sequences until
                 # the pool has headroom again — admission watermark should
-                # make this rare, never fatal
+                # make this rare, never fatal. Speculative headroom blocks
+                # grabbed before the failure are handed back first so the
+                # shed is no bigger than the non-speculative lane's.
+                if self.config.spec_k > 0:
+                    for s in batch:
+                        try:
+                            self.kv.truncate_sequence(s.seq_id,
+                                                      s.context_len())
+                        except KeyError:
+                            pass
                 victim = batch[-1]
                 self._finish(victim, errors.EOVERCROWDED,
                              "kv pool exhausted mid-decode")
@@ -688,8 +765,64 @@ class ServingEngine:
         self.last_step_us = (time.perf_counter_ns() - t0) / 1000.0
         g_serving_step.record(self.last_step_us)
 
+    def _commit_speculative(self, batch: List[Sequence],
+                            drafts: List[List[int]],
+                            outs: List[np.ndarray]) -> None:
+        """Greedy acceptance + KV rollback for one verify step. Per
+        sequence: commit the longest draft prefix agreeing with the
+        verifier's argmax plus the one bonus token (cut short at
+        stop/max_new), stream ONE TokenDelta carrying the accepted
+        count, roll rejected tail blocks back via ``truncate_sequence``
+        (the garbage K/V left *inside* retained blocks sits past the
+        committed context, and next step's contiguous verify rows
+        rewrite every such position before any row can attend to it),
+        and feed the AdaptiveK controller."""
+        step_drafted = step_accepted = 0
+        for s, d, m in zip(batch, drafts, outs):
+            a, committed = _spec.accept_longest_prefix(d, m)
+            ncommit = 0
+            for tok in committed:
+                self._append_token(s, tok, stream=False)
+                ncommit += 1
+                if s.state == STATE_DONE:
+                    break
+            accepted_sent = min(ncommit, a)
+            self._stream_delta(s, committed[:ncommit],
+                               s.state == STATE_DONE,
+                               accepted=accepted_sent)
+            # rejected rows wrote K/V past the committed context; drop
+            # whole tail blocks now, let next step's writes mask the rest
+            self.kv.truncate_sequence(s.seq_id, s.context_len())
+            was_collapsed = s.spec.collapsed
+            s.spec.update(len(d), a)
+            # the +1 bonus is only a *speculative* gain when the step
+            # drafted; an empty-draft step is a plain decode token
+            bonus = (ncommit - accepted_sent) if d else 0
+            step_drafted += len(d)
+            step_accepted += a
+            if self.spec_stats is not None:
+                st = self.spec_stats
+                st.drafted += len(d)
+                st.accepted += a
+                st.rejected += len(d) - a
+                st.bonus += bonus
+                if d:
+                    st.spec_steps += 1
+                if s.spec.collapsed and not was_collapsed:
+                    st.collapsed_seqs += 1
+            if d:
+                _spec.g_serving_spec_draft_tokens.put(len(d))
+                if a:
+                    _spec.g_serving_spec_accepted_tokens.put(a)
+                if len(d) - a:
+                    _spec.g_serving_spec_rejected_tokens.put(len(d) - a)
+            if bonus:
+                _spec.g_serving_spec_bonus_tokens.put(bonus)
+        _spec.note_step(step_drafted, step_accepted)
+
     # ----------------------------------------------------------- completion
-    def _append_token(self, seq: Sequence, tok: int) -> None:
+    def _append_token(self, seq: Sequence, tok: int,
+                      stream: bool = True) -> None:
         now = time.monotonic()
         if not seq.out_tokens:
             seq.t_first_token = now
@@ -706,7 +839,8 @@ class ServingEngine:
         g_serving_tokens.put(1)
         finished = (len(seq.out_tokens) >= seq.max_new_tokens
                     or (seq.stop_token and tok == seq.stop_token))
-        self._stream_delta(seq, [tok], finished)
+        if stream:
+            self._stream_delta(seq, [tok], finished)
         if finished:
             seq.finish_reason = ("stop_token"
                                  if seq.stop_token and tok == seq.stop_token
@@ -714,7 +848,7 @@ class ServingEngine:
             seq.state = STATE_DONE
 
     def _stream_delta(self, seq: Sequence, toks: List[int],
-                      done: bool) -> None:
+                      done: bool, accepted: int = 0) -> None:
         if not seq.stream_id:
             return
         from brpc_tpu.proto import serving_pb2
@@ -722,7 +856,7 @@ class ServingEngine:
 
         delta = serving_pb2.TokenDelta(
             seq_id=seq.seq_id, tokens=toks,
-            step=len(seq.out_tokens), done=done)
+            step=len(seq.out_tokens), done=done, accepted=accepted)
         rc = stream_write(seq.stream_id, delta.SerializeToString())
         if rc != 0:
             seq.stream_id = 0  # stream died; finish via the RPC response
@@ -910,4 +1044,7 @@ class ServingEngine:
             "kv": kv,
             "prefix": (self.prefix.snapshot()
                        if self.prefix is not None else None),
+            "spec": (dict(self.spec_stats.snapshot(),
+                          k_max=self.config.spec_k)
+                     if self.spec_stats is not None else None),
         }

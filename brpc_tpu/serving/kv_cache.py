@@ -235,6 +235,33 @@ class PagedKVCache:
             g_serving_kv_block_allocs.put(grew)
         return list(table)
 
+    def truncate_sequence(self, seq_id: int, new_len: int) -> int:
+        """Shrink a table back to ``new_len`` tokens (speculative-decode
+        rollback): tail blocks past ``blocks_for(new_len)`` drop one ref
+        and return to the free list at zero, exactly mirroring
+        ``free_sequence``'s accounting so the armed audit and the
+        prefix-cache refcounts stay balanced. Returns blocks freed."""
+        freed = 0
+        with self._lock:
+            table = self._tables.get(seq_id)
+            if table is None:
+                raise KeyError(f"unknown sequence {seq_id}")
+            keep = self.blocks_for(new_len)
+            while len(table) > keep:
+                b = table.pop()
+                self._ref[b] -= 1
+                if self._ref[b] == 0:
+                    del self._ref[b]
+                    self._free.append(b)
+                    freed += 1
+            self._seq_len[seq_id] = min(self._seq_len.get(seq_id, new_len),
+                                        new_len)
+            self._quiesced.discard(seq_id)
+            self._audit_locked()
+        if freed:
+            g_serving_kv_block_frees.put(freed)
+        return freed
+
     def fork_sequence(self, src_seq: int, dst_seq: int) -> List[int]:
         """Share ``src``'s blocks with a new sequence (refcount++); the
         caller copies the partial tail block device-side before either
@@ -744,6 +771,12 @@ class ShardedKVCache:
             raise KeyError(f"unknown sequence {seq_id}")
         shard, pool = got
         return ShardTable(shard, pool.extend_sequence(seq_id, new_len))
+
+    def truncate_sequence(self, seq_id: int, new_len: int) -> int:
+        got = self._pool_of(seq_id)
+        if got is None:
+            raise KeyError(f"unknown sequence {seq_id}")
+        return got[1].truncate_sequence(seq_id, new_len)
 
     def fork_sequence(self, src_seq: int, dst_seq: int) -> ShardTable:
         """Device-local fork: the child shares the parent's blocks, so it

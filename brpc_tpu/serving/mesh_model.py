@@ -221,7 +221,14 @@ class MeshTransformer(TinyTransformer):
                     tables: List[Sequence[int]]) -> np.ndarray:
         """ONE fused launch for the WHOLE mesh: sequences grouped by
         owning dp shard, per-shard sub-batches padded to a common bucket,
-        one shard_map program, one host materialization."""
+        one shard_map program, one host materialization.
+
+        Speculative ``verify_step`` rides this unchanged: a sequence's
+        k+1 verify rows share its ShardTable, so the shard grouping keeps
+        them contiguous and in position order on the owning dp shard —
+        the per-shard ``_decode_body`` sees exactly the single-pool row
+        layout and the verify lowering stays bit-identical across tp/dp
+        splits, still one launch and one sync for the whole mesh."""
         bs = self.kv.block_size
         B = len(tokens)
         self.kv.assert_writable_batch(tables, positions)

@@ -404,6 +404,46 @@ class TinyTransformer:
         step_dispatch.note_host_sync()
         return out
 
+    def verify_step(self, last_tokens: Sequence[int],
+                    positions: Sequence[int], tables: List[Sequence[int]],
+                    drafts: List[Sequence[int]]) -> List[np.ndarray]:
+        """Speculative verify: ONE fused launch scoring every sequence's
+        last committed token plus its k drafted tokens — k+1 rows per
+        sequence flattened into the same fused decode program steady-state
+        decode uses (the ``prefill_suffix`` trick, batched). Inside one
+        launch every row's K/V write lands before any row's gather and
+        the causal mask limits row j to positions ≤ its own, so row j
+        attends over rows 0..j-1's *same-launch* writes: the returned
+        argmax per row is exactly what k+1 sequential decode steps would
+        produce. One launch, one host materialization — the (1,1)
+        dispatch invariant holds for arbitrary k. Returns one array of
+        k_i+1 argmax tokens per sequence (``m_0..m_k``: the verifier's
+        next-token at the last committed position and after each draft).
+        Rows of a sequence share its table, so the mesh model's
+        shard-grouped ``decode_step`` keeps them on the owning dp shard
+        in order — verify inherits bit-identical tp/dp lowering with no
+        mesh-specific code."""
+        flat_tokens: List[int] = []
+        flat_pos: List[int] = []
+        flat_tables: List[Sequence[int]] = []
+        counts: List[int] = []
+        for t0, p0, table, d in zip(last_tokens, positions, tables, drafts):
+            row_toks = [int(t0)] + [int(x) for x in d]
+            for j, tok in enumerate(row_toks):
+                flat_tokens.append(tok)
+                flat_pos.append(int(p0) + j)
+                flat_tables.append(table)
+            counts.append(len(row_toks))
+        out = self.decode_step(np.asarray(flat_tokens, dtype=np.int32),
+                               np.asarray(flat_pos, dtype=np.int32),
+                               flat_tables)
+        res: List[np.ndarray] = []
+        off = 0
+        for c in counts:
+            res.append(out[off:off + c])
+            off += c
+        return res
+
     # ------------------------------------------------------------- helpers
     def close(self) -> None:
         self.store.free(self.param_handle)

@@ -6,7 +6,13 @@ RPC from its step loop when generation finishes (or is rejected/aborted).
 A request that arrives with stream settings (client created a stream and
 set ``cntl.stream_id``) is accepted before admission; TokenDelta frames
 then flow per step, so the client's first token arrives while the RPC is
-still in flight — TTFT < full-generation latency by construction.
+still in flight — TTFT < full-generation latency by construction. On a
+speculative engine (``EngineConfig(spec_k=)``) one step can commit up to
+k+1 tokens, so a frame carries a token *list* plus ``accepted`` — how
+many of those tokens were drafted and verifier-accepted (the +1 bonus
+token is excluded); non-speculative frames stream one token with
+``accepted == 0``. Frame concatenation equals the final response token
+list either way.
 
 Requests carrying stream settings take the server's full dispatch path
 (the slim/fast lanes only accept requests without them), which is also

@@ -276,6 +276,77 @@ def test_serving_phase_skips_others(serving_bench_run):
     assert "# tpu:// sweep" not in err
     assert "# batch lane (" not in err
     assert "# device lane" not in err
+    assert "# serving spec:" not in err
+
+
+@pytest.fixture(scope="module")
+def spec_bench_run():
+    env = dict(os.environ,
+               BENCH_QUICK="1",
+               BENCH_PHASES="spec",
+               BENCH_SKIP_DEVICE="1",
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                          capture_output=True, text=True, timeout=300,
+                          cwd=REPO, env=env)
+    assert proc.returncode == 0, \
+        f"bench.py failed rc={proc.returncode}:\n{proc.stderr[-2000:]}"
+    return proc
+
+
+def test_spec_lane_json_metrics(spec_bench_run):
+    """The spec phase emits exactly its three machine-readable lines:
+    the speculative-vs-baseline tokens/s A/B, the run's accept rate, and
+    the per-user decode latency pair."""
+    rows = [json.loads(l) for l in spec_bench_run.stdout.splitlines()
+            if l.startswith("{")]
+    by = {r["metric"]: r for r in rows}
+    assert set(by) == {"serving_spec_tokens_per_s",
+                       "serving_spec_accept_rate",
+                       "serving_spec_itl_ms"}, spec_bench_run.stdout
+    tps = by["serving_spec_tokens_per_s"]
+    assert tps["unit"] == "tokens/s" and tps["value"] > 0, tps
+    assert tps["baseline"] > 0, tps
+    itl = by["serving_spec_itl_ms"]
+    assert itl["unit"] == "ms" and itl["value"] > 0, itl
+    assert itl["baseline_ms"] > 0, itl
+
+
+def test_spec_beats_baseline_by_1_3x(spec_bench_run):
+    """The acceptance floor: on the repetition-heavy corpus the
+    draft+verify lane must clear 1.3x the non-speculative engine's
+    tokens/s — k accepted drafts plus the bonus token ride one fused
+    verify launch, so committed tokens per dispatch goes up while the
+    bit-identity oracle (checked inside the lane, gated exactly in
+    test_serving_spec.py) pins correctness."""
+    rows = [json.loads(l) for l in spec_bench_run.stdout.splitlines()
+            if l.startswith("{")]
+    tps = [r for r in rows if r["metric"] == "serving_spec_tokens_per_s"][0]
+    assert tps["ratio"] >= 1.3, tps
+    lane = [l for l in spec_bench_run.stderr.splitlines()
+            if l.startswith("# serving spec:")]
+    assert lane and "OK 1.3x floor" in lane[0], \
+        spec_bench_run.stderr[-2000:]
+
+
+def test_spec_accept_rate_on_repetitive_corpus(spec_bench_run):
+    """Prompt-lookup must actually hit on the motif corpus — an accept
+    rate near zero means the lane is winning (or losing) for the wrong
+    reason."""
+    rows = [json.loads(l) for l in spec_bench_run.stdout.splitlines()
+            if l.startswith("{")]
+    ar = [r for r in rows if r["metric"] == "serving_spec_accept_rate"][0]
+    assert ar["unit"] == "ratio", ar
+    assert ar["drafted"] > 0 and ar["accepted"] > 0, ar
+    assert ar["value"] >= 0.5, ar
+
+
+def test_spec_phase_skips_others(spec_bench_run):
+    err = spec_bench_run.stderr
+    assert "# serving lane:" not in err
+    assert "# tpu:// sweep" not in err
+    assert "# batch lane (" not in err
+    assert "# device lane" not in err
 
 
 def test_zero_copy_counters_emitted(bench_run):

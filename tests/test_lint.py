@@ -19,6 +19,7 @@ EXPECTED_RULES = {
     "named-thread", "cross-process-ownership", "metric-churn",
     "no-per-token-host-sync", "no-per-op-step-dispatch",
     "cow-before-write", "quiesce-before-migrate",
+    "draft-no-device-sync",
 }
 
 
@@ -1001,6 +1002,76 @@ class TestQuiesceBeforeMigrate:
         res = _lint(tmp_path, {"serving/debug.py": """\
             def peek(self, seq, kv):
                 return kv.export_chain(seq.seq_id)  # tpulint: disable=quiesce-before-migrate
+            """}, rules=self.RULE)
+        assert res.clean
+        assert len(res.suppressed) == 1
+
+
+class TestDraftNoDeviceSync:
+    RULE = ["draft-no-device-sync"]
+
+    def test_jax_import_fires(self, tmp_path):
+        res = _lint(tmp_path, {"serving/speculative.py": """\
+            import jax
+
+            def draft_tokens(history, k):
+                return history[-k:]
+            """}, rules=self.RULE)
+        assert [f.rule for f in res.findings] == ["draft-no-device-sync"]
+        assert res.findings[0].line == 1
+        assert "host-side" in res.findings[0].message
+
+    def test_jax_from_import_fires(self, tmp_path):
+        res = _lint(tmp_path, {"serving/speculative.py": """\
+            from jax import numpy as jnp
+
+            def draft_tokens(history, k):
+                return list(jnp.asarray(history)[-k:])
+            """}, rules=self.RULE)
+        assert not res.clean
+
+    def test_jit_call_fires(self, tmp_path):
+        res = _lint(tmp_path, {"serving/speculative.py": """\
+            def draft_tokens(history, k, matcher):
+                fn = matcher.jit(history)
+                out = fn(k)
+                out.block_until_ready()
+                return out
+            """}, rules=self.RULE)
+        assert len(res.findings) == 2
+        assert "ONE launch" in res.findings[0].message
+
+    def test_host_side_matcher_passes(self, tmp_path):
+        # the house drafter: pure Python over committed token history
+        res = _lint(tmp_path, {"serving/speculative.py": """\
+            def draft_tokens(history, k, ngram_max=3):
+                h = [int(t) for t in history]
+                for n in range(min(ngram_max, len(h) - 1), 0, -1):
+                    tail = h[-n:]
+                    for j in range(len(h) - n - 1, -1, -1):
+                        if h[j:j + n] == tail:
+                            return h[j + n:j + n + k]
+                return []
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_same_code_outside_scope_passes(self, tmp_path):
+        # jit/device dispatch is the model's job — only the draft lane
+        # is pinned host-side
+        res = _lint(tmp_path, {"serving/model.py": """\
+            import jax
+
+            def decode_fn(self, bucket):
+                return jax.jit(self._impl)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_suppression_honored(self, tmp_path):
+        res = _lint(tmp_path, {"serving/speculative.py": """\
+            import jax  # tpulint: disable=draft-no-device-sync
+
+            def draft_tokens(history, k):
+                return history[-k:]
             """}, rules=self.RULE)
         assert res.clean
         assert len(res.suppressed) == 1
