@@ -22,10 +22,11 @@ loopback plateau (/root/reference/docs/cn/benchmark.md:104).
 
 Env knobs: BENCH_QUICK=1 shortens every phase (CI smoke); BENCH_SKIP_DEVICE=1
 skips the jax probe; BENCH_PHASES=shm,qps,native,hybrid,batch,serving,spec,
-device runs only the named phases (default: all) — e.g. BENCH_PHASES=shm is
-the CPU-only tier-1 smoke lane, whose headline is then the Python tpu://
+qos,device runs only the named phases (default: all) — e.g. BENCH_PHASES=shm
+is the CPU-only tier-1 smoke lane, whose headline is then the Python tpu://
 sweep; batch is the adaptive-batching vs per-request dispatch comparison
-(also CPU-only); spec is the speculative-decoding draft+verify A/B.
+(also CPU-only); spec is the speculative-decoding draft+verify A/B; qos is
+the multi-tenant overload A/B (protected p99 + shed rate).
 """
 
 from __future__ import annotations
@@ -1015,6 +1016,127 @@ def bench_spec_lane():
     return ratio
 
 
+def bench_qos_lane():
+    """Multi-tenant QoS A/B under a best-effort flood: two engines see
+    the same offered load — a ``batch`` tenant (priority 0) dumping a
+    saturating wave, then a ``prod`` tenant (priority 1, weight 4)
+    submitting its steady work. The QoS engine meters admission by
+    weighted fair share and sheds batch past its queue cap
+    (EOVERCROWDED, retriable); the control engine is the plain FIFO
+    path, where prod queues behind the entire flood. Emits the
+    protected tenant's p99 (vs its unloaded p99 and the FIFO engine's
+    flooded p99) and the shed rate — the overload-survival headline
+    tests/test_bench_quick.py floor-gates."""
+    import numpy as np
+
+    from brpc_tpu.serving import (EngineConfig, KVCacheConfig, ModelConfig,
+                                  PagedKVCache, QosConfig, ServingEngine,
+                                  TinyTransformer)
+
+    cfg = ModelConfig(vocab=256, d_model=32, n_heads=2, n_layers=2)
+    FLOOD, PROD_REQS = 32, 8
+    PLEN, MAX_NEW = 16, 8
+    qos_cfg = QosConfig(tenants={"prod": 4.0, "batch": 1.0},
+                        queue_cap=12, protected_priority=1)
+
+    def build(qos):
+        kv = PagedKVCache(KVCacheConfig(block_size=16, num_blocks=256),
+                          cfg.n_layers, cfg.kv_dim)
+        model = TinyTransformer(cfg, kv)
+        # max_batch=2 + a tight budget keeps the flood saturating for
+        # many steps — the regime where admission ORDER is the outcome
+        return ServingEngine(model, kv, EngineConfig(
+            max_batch=2, token_budget=64, max_queue=256,
+            idle_wait_s=0.002, qos=qos), prefix_cache=False).start()
+
+    def submit(engine, tenant, priority, lats, sheds, pend):
+        t0 = time.perf_counter()
+        ev = threading.Event()
+        code, _ = engine.submit(
+            engine.model.synth_prompt(PLEN), MAX_NEW,
+            tenant_id=tenant, priority=priority,
+            done=lambda r, ev=ev, t0=t0: (
+                lats.append(time.perf_counter() - t0), ev.set()))
+        if code != 0:
+            sheds.append(code)
+        else:
+            pend.append(ev)
+
+    def drain(pend):
+        for ev in pend:
+            if not ev.wait(300):
+                raise RuntimeError("qos bench stalled")
+
+    def flood_run(engine):
+        """The overload wave: batch floods, then prod submits its work.
+        Returns (prod_p99_s, batch_shed, batch_sent)."""
+        prod_lats, batch_lats = [], []
+        prod_shed, batch_shed = [], []
+        pend = []
+        for _ in range(FLOOD):
+            submit(engine, "batch", 0, batch_lats, batch_shed, pend)
+        for _ in range(PROD_REQS):
+            submit(engine, "prod", 1, prod_lats, prod_shed, pend)
+        drain(pend)
+        if prod_shed:
+            raise RuntimeError("protected tenant was shed")
+        return (sorted(prod_lats)[max(0, int(len(prod_lats) * 0.99) - 1)],
+                len(batch_shed), FLOOD)
+
+    qos_eng = build(qos_cfg)
+    fifo = build(None)
+    try:
+        # compile both buckets on both engines (2nd donated signature)
+        for eng in (qos_eng, fifo):
+            for _ in range(2):
+                lats, sheds, pend = [], [], []
+                submit(eng, "prod", 1, lats, sheds, pend)
+                drain(pend)
+        # unloaded: the protected tenant alone, sequentially
+        unloaded = []
+        for _ in range(PROD_REQS):
+            lats, sheds, pend = [], [], []
+            submit(qos_eng, "prod", 1, lats, sheds, pend)
+            drain(pend)
+            unloaded.extend(lats)
+        unloaded_p99 = sorted(unloaded)[max(0,
+                                            int(len(unloaded) * 0.99) - 1)]
+        qos_p99, shed, sent = flood_run(qos_eng)
+        fifo_p99, fifo_shed, _ = flood_run(fifo)
+    finally:
+        qos_eng.stop()
+        fifo.stop()
+        qos_eng.model.close()
+        fifo.model.close()
+    ratio = qos_p99 / max(unloaded_p99, 1e-9)
+    vs_fifo = fifo_p99 / max(qos_p99, 1e-9)
+    shed_rate = shed / sent
+    print(f"# serving qos: flood={FLOOD} batch + {PROD_REQS} prod: "
+          f"protected p99 {qos_p99 * 1e3:.1f}ms "
+          f"(unloaded {unloaded_p99 * 1e3:.1f}ms, {ratio:.1f}x; "
+          f"fifo {fifo_p99 * 1e3:.1f}ms, qos {vs_fifo:.1f}x better) | "
+          f"batch shed {shed}/{sent} ({shed_rate:.0%}) "
+          f"fifo shed {fifo_shed}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "serving_qos_protected_p99_ms",
+        "value": round(qos_p99 * 1e3, 3),
+        "unit": "ms",
+        "unloaded_ms": round(unloaded_p99 * 1e3, 3),
+        "ratio_vs_unloaded": round(ratio, 3),
+        "fifo_ms": round(fifo_p99 * 1e3, 3),
+        "fifo_ratio": round(vs_fifo, 3),
+    }))
+    print(json.dumps({
+        "metric": "serving_qos_shed_rate",
+        "value": round(shed_rate, 3),
+        "unit": "ratio",
+        "shed": shed,
+        "sent": sent,
+        "fifo_shed": fifo_shed,
+    }))
+    return vs_fifo
+
+
 def bench_native_lane():
     """The framework's native lane end to end: C++ bench client (the analog
     of the reference's C++ client binaries) against the C++ engine serving
@@ -1717,6 +1839,8 @@ def main() -> None:
         bench_serving_lane()
     if _phase_enabled("spec"):
         bench_spec_lane()
+    if _phase_enabled("qos"):
+        bench_qos_lane()
     py_1mb = py_64b_qps = series_pct = None
     if _phase_enabled("shm"):
         py_1mb, py_64b_qps = bench_tpu_sweep()

@@ -1186,3 +1186,78 @@ def rule_draft_no_device_sync(pkg: Package) -> List[Finding]:
                         f"ONE sync; draft from the committed host-side "
                         f"history instead"))
     return out
+
+
+# --------------------------------------------------------------------------
+# Rule 17: shed-before-queue
+# --------------------------------------------------------------------------
+# The QoS overload contract (docs/serving.md §Multi-tenant QoS): every
+# sequence that lands on the engine's waiting queue has already passed
+# the admission predicate — deadline still live, tenant under its queue
+# cap, the limiter ceiling not exceeded. A new code path that appends to
+# a waiting lane without consulting the check silently reopens the
+# unbounded-queue failure mode the closed loop exists to prevent: the
+# governor only sheds what it can see, and an unchecked append is load
+# the ceiling never metered. The runtime re-check inside
+# TenantScheduler.enqueue guards the paths tests exercise; this rule
+# pins the invariant at lint time for paths they don't.
+
+_QOS_SCOPE_PREFIXES = ("serving/",)
+_QOS_QUEUE_ATTRS = {"waiting", "_waiting"}
+_QOS_ADMIT_GUARDS = ("can_admit", "admission_check")
+
+
+def _queue_append_sites(func: ast.AST) -> List[ast.Call]:
+    sites: List[ast.Call] = []
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Call):
+            continue
+        name = attr_chain(node.func)
+        if name is None:
+            continue
+        parts = name.split(".")
+        if (len(parts) >= 2 and parts[-1] == "append"
+                and parts[-2] in _QOS_QUEUE_ATTRS):
+            sites.append(node)
+    return sites
+
+
+def _admission_guarded(func: ast.AST) -> bool:
+    """True when the function consults the admission predicate anywhere
+    in its body: a call whose final attribute names the KV watermark
+    check (can_admit) or the QoS check (admission_check)."""
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            name = attr_chain(node.func)
+            if name is None:
+                continue
+            last = name.split(".")[-1]
+            if any(g in last for g in _QOS_ADMIT_GUARDS):
+                return True
+    return False
+
+
+@register_rule(
+    "shed-before-queue",
+    "serving/ functions appending to a waiting queue must consult the "
+    "admission check (deadline + tenant cap + limiter ceiling) in the "
+    "same function — no append may bypass QoS shedding")
+def rule_shed_before_queue(pkg: Package) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in pkg.files:
+        if not in_scope(sf.rel, prefixes=_QOS_SCOPE_PREFIXES):
+            continue
+        for func, cls in iter_functions(sf.tree):
+            sites = _queue_append_sites(func)
+            if not sites or _admission_guarded(func):
+                continue
+            where = f"{cls}.{func.name}" if cls else func.name
+            for call in sites:
+                out.append(Finding(
+                    "shed-before-queue", sf.rel, call.lineno,
+                    f"{where}() appends to a waiting queue with no "
+                    f"admission check in scope — queue growth the "
+                    f"limiter ceiling never metered reopens unbounded "
+                    f"queueing under overload; consult "
+                    f"can_admit/admission_check before the append"))
+    return out

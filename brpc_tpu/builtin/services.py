@@ -687,6 +687,24 @@ def serving_service(server, http: HttpMessage):
                 f"accepted={sp['accepted']} rejected={sp['rejected']} "
                 f"bonus={sp['bonus']} accept_rate={sp['accept_rate']:.2f} "
                 f"collapsed_seqs={sp['collapsed_seqs']}")
+        # multi-tenant QoS: the limiter ceiling the governor is holding,
+        # and each tenant's fair-share lane (weight, backlog, realized
+        # token share, sheds)
+        qos = s.get("qos")
+        if qos:
+            lim = qos["limiter"]
+            out.append(
+                f"  qos: ceiling={lim['ceiling']:.1f} "
+                f"inflight={qos['inflight']} "
+                f"occupancy={qos['occupancy']:.2f} "
+                f"oldest_wait_ms={qos['oldest_wait_ms']:.1f} "
+                f"protected_priority>={qos['protected_priority']}")
+            for name, t in qos["tenants"].items():
+                out.append(
+                    f"    [tenant {name}] weight={t['weight']:g} "
+                    f"queued={t['queued']} admitted={t['admitted']} "
+                    f"tokens={t['admitted_tokens']} "
+                    f"share={t['token_share']:.2f} shed={t['shed']}")
         # disaggregated serving: outbound handoff counters on prefill
         # engines, inbound adoption counters on decode engines, plus the
         # parked (adopted-not-yet-attached) sequence count
@@ -770,5 +788,5 @@ register_builtin("dump", dump_service,
                  "dump files")
 register_builtin("serving", serving_service,
                  "serving engines: batch occupancy, kv watermark, queue "
-                 "depth, step timings, per-shard occupancy/latency "
-                 "(?format=json)")
+                 "depth, step timings, qos tenant lanes, per-shard "
+                 "occupancy/latency (?format=json)")

@@ -31,6 +31,12 @@ class Controller:
         self.request_attachment = b""
         self.response_attachment = b""
         self.log_id = 0
+        # multi-tenant QoS identity: rides RequestMeta like log_id does
+        # (client sets before the call; server side carries the decoded
+        # values for admission/fair-share billing). priority: higher =
+        # more protected under overload shedding.
+        self.tenant_id = ""
+        self.priority = 0
         self.compress_type = _compress.COMPRESS_NONE
         # client side
         self.timeout_ms: Optional[int] = None
@@ -163,6 +169,10 @@ class Controller:
         meta.request.method_name = self._method.method_name
         meta.request.log_id = self.log_id
         meta.request.timeout_ms = self.timeout_ms or 0
+        if self.tenant_id:
+            meta.request.tenant_id = self.tenant_id
+        if self.priority:
+            meta.request.priority = self.priority
         meta.correlation_id = cid
         meta.attempt_version = _cid.id_version(cid)
         meta.compress_type = self.compress_type
@@ -394,6 +404,8 @@ class Controller:
         c.service_name = meta.request.service_name
         c.method_name = meta.request.method_name
         c.log_id = meta.request.log_id
+        c.tenant_id = meta.request.tenant_id
+        c.priority = meta.request.priority
         return c
 
 

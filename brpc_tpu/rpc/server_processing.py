@@ -476,6 +476,10 @@ def _process_request_slim(protocol, msg, server, meta) -> bool:
                                 budget_ms)
     cntl.span = span
     cntl._srv_socket = sock  # batch runtime reads this (priority flush)
+    if req.tenant_id:
+        cntl.tenant_id = req.tenant_id
+    if req.priority:
+        cntl.priority = req.priority
     if deadline_mono:
         cntl.deadline_mono = deadline_mono
     done = _SlimDone(protocol, sock, meta, cntl, entry, server, start_us)
@@ -560,6 +564,11 @@ class FastServerController:
     _accepted_stream_id = 0
     stream_id = 0
     deadline_mono = 0.0  # monotonic deadline (0 = none); batch admit checks
+    # QoS identity class defaults — most traffic is single-tenant; the
+    # slim dispatch shadows them per instance only when the meta carries
+    # them (native fast-path tuples don't, by the fixed-field contract)
+    tenant_id = ""
+    priority = 0
 
     def __init__(self, server, sock, svc, meth, log_id, timeout_ms):
         self.server = server

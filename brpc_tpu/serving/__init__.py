@@ -36,6 +36,12 @@ mechanism:
   drains its live sequences onto survivors, with the paged ledger's
   quiesce/export/adopt handshake keeping block ownership single-writer
   throughout.
+- :mod:`brpc_tpu.serving.qos` — multi-tenant QoS: weighted fair-share
+  admission (stride-scheduled token budget per tenant), per-tenant
+  queue caps, and the closed-loop overload governor — an AutoLimiter
+  gradient ceiling driven by the queue-wait series ring, shedding
+  best-effort lanes first so a protected tenant survives an overload
+  wave EOVERCROWDED-retriable instead of everyone drowning together.
 - :mod:`brpc_tpu.serving.speculative` — the speculative-decoding draft
   lane: host-side prompt-lookup drafting (zero weights, zero device
   work, lint-pinned) feeding the model's one fused ``verify_step``
@@ -50,6 +56,8 @@ from brpc_tpu.serving.engine import EngineConfig, ServingEngine, active_engines
 from brpc_tpu.serving.prefix_cache import (PrefixCache, ShardedPrefixCache,
                                            build_prefix_cache,
                                            prefix_route_key)
+from brpc_tpu.serving.qos import (QosConfig, QosGovernor, QosLimiter,
+                                  TenantScheduler)
 from brpc_tpu.serving.service import LlmServingService
 from brpc_tpu.serving.speculative import (AdaptiveK, accept_longest_prefix,
                                           draft_tokens)
@@ -85,4 +93,5 @@ __all__ = [
     "LlmServingService", "ShardedLlmChannel",
     "KVMigrator", "MigrationReceiver",
     "AdaptiveK", "accept_longest_prefix", "draft_tokens",
+    "QosConfig", "QosGovernor", "QosLimiter", "TenantScheduler",
 ]

@@ -53,6 +53,7 @@ from brpc_tpu.metrics.reducer import Adder
 from brpc_tpu.metrics.status import PassiveStatus
 from brpc_tpu.profiling import registry as _prof
 from brpc_tpu.rpc import errors
+from brpc_tpu.serving import qos as _qos
 from brpc_tpu.serving import speculative as _spec
 from brpc_tpu.serving.kv_cache import KVCacheFull, PagedKVCache
 from brpc_tpu.serving.model import TinyTransformer
@@ -112,7 +113,7 @@ class EngineConfig:
                  scheduling: str = SCHED_CONTINUOUS,
                  idle_wait_s: float = 0.05, role: str = ROLE_BOTH,
                  spec_k: int = 0, spec_ngram: int = 3,
-                 spec_collapse_after: int = 4):
+                 spec_collapse_after: int = 4, qos=None):
         if scheduling not in (SCHED_CONTINUOUS, SCHED_STATIC):
             raise ValueError(f"unknown scheduling {scheduling!r}")
         if role not in (ROLE_PREFILL, ROLE_DECODE, ROLE_BOTH):
@@ -141,6 +142,10 @@ class EngineConfig:
         self.spec_k = spec_k
         self.spec_ngram = spec_ngram
         self.spec_collapse_after = spec_collapse_after
+        # multi-tenant QoS: a serving.qos.QosConfig turns admission into
+        # weighted fair share + the closed-loop overload governor; None
+        # keeps the single-tenant FIFO path byte-for-byte as before
+        self.qos = qos
 
 
 STATE_WAITING = "waiting"
@@ -156,7 +161,8 @@ class Sequence:
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
                  stop_token: int = 0, cntl=None, done=None,
-                 stream_id: int = 0):
+                 stream_id: int = 0, tenant_id: str = "",
+                 priority: int = 0):
         with Sequence._ids_lock:
             Sequence._ids[0] += 1
             self.seq_id = Sequence._ids[0]
@@ -166,6 +172,10 @@ class Sequence:
         self.cntl = cntl
         self.done = done
         self.stream_id = stream_id
+        # QoS identity (decoded off RequestMeta by the dispatch paths):
+        # which fair-share lane this bills, how protected under shedding
+        self.tenant_id = tenant_id
+        self.priority = priority
         self.state = STATE_WAITING
         self.out_tokens: List[int] = []
         # tokens covered by a forked prefix-cache chain (block-aligned);
@@ -245,6 +255,13 @@ class ServingEngine:
         # the oracle need per-lane isolation, like the fields above)
         self.spec_stats = (_spec.SpecStats()
                            if self.config.spec_k > 0 else None)
+        # multi-tenant QoS: the fair-share scheduler replaces _waiting
+        # as the queue substrate and the governor closes the overload
+        # loop from the sampler tick (installed in start())
+        self.qos = (_qos.TenantScheduler(self.config.qos, engine=self)
+                    if self.config.qos is not None else None)
+        self._qos_governor = (_qos.QosGovernor(self)
+                              if self.qos is not None else None)
         # per-shard decode attribution: shard -> [steps, total_us,
         # last_us, seq_steps] (only shards with live sequences tick)
         self._shard_step: Dict[int, List[float]] = {}
@@ -257,6 +274,16 @@ class ServingEngine:
             if self.running:
                 return self
             self.running = True
+        if self._qos_governor is not None:
+            # close the loop: the governor rides the 1 Hz sampler tick,
+            # sampling the queue-wait series ring the sweep just filled
+            from brpc_tpu.metrics.series import (ensure_series_installed,
+                                                 global_series)
+
+            ensure_series_installed()
+            hooks = global_series().post_tick_hooks
+            if self._qos_governor not in hooks:
+                hooks.append(self._qos_governor)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="brpc-serving-engine")
         self._thread.start()
@@ -276,6 +303,13 @@ class ServingEngine:
                 return
             self.running = False
             self._cv.notify_all()
+        if self._qos_governor is not None:
+            from brpc_tpu.metrics.series import global_series
+
+            try:
+                global_series().post_tick_hooks.remove(self._qos_governor)
+            except ValueError:
+                pass
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
@@ -301,14 +335,22 @@ class ServingEngine:
     def submit(self, prompt: np.ndarray, max_new_tokens: int,
                stop_token: int = 0, cntl=None, done=None,
                stream_id: int = 0,
-               resume_seq_id: int = 0) -> "tuple[int, Optional[Sequence]]":
+               resume_seq_id: int = 0, tenant_id: Optional[str] = None,
+               priority: Optional[int] = None,
+               _synthetic: bool = False) -> "tuple[int, Optional[Sequence]]":
         """Admission front door (runs on the RPC thread). Returns
         (error_code, seq): 0 + the queued sequence, or a reject code the
         caller surfaces through cntl.set_failed.
 
         ``resume_seq_id`` attaches to a migrated-in sequence (two-stage
         disaggregated dispatch: the stage-1 handoff reply named it) —
-        no admission, no allocation, the chain is already here."""
+        no admission, no allocation, the chain is already here.
+
+        ``tenant_id``/``priority`` default to the wire identity on
+        ``cntl`` (RequestMeta → dispatch → cntl); pass them explicitly
+        when no controller carries them. ``_synthetic`` marks burst
+        clones fabricated by the serving.qos.burst fault point so they
+        cannot re-trigger it."""
         if resume_seq_id:
             with self._cv:
                 seq = self._adopted.get(resume_seq_id)
@@ -341,10 +383,26 @@ class ServingEngine:
             self.kv.note_rejected()
             g_serving_rejected.put(1)
             return errors.EOVERCROWDED, None
+        if tenant_id is None:
+            tenant_id = getattr(cntl, "tenant_id", "") if cntl else ""
+        if priority is None:
+            priority = getattr(cntl, "priority", 0) if cntl else 0
+        if self.qos is not None and not _synthetic:
+            # chaos: inflate this tenant's arrival rate at admission —
+            # each real submit fans out factor-1 synthetic clones that
+            # bill the same lane (and shed the same way)
+            burst = _fault.hit("serving.qos.burst", tenant=tenant_id)
+            if burst is not None:
+                for _ in range(max(0, int(burst.get("factor", 2)) - 1)):
+                    self.submit(prompt, max_new_tokens,
+                                stop_token=stop_token,
+                                tenant_id=tenant_id, priority=priority,
+                                _synthetic=True)
         with self._cv:
             if not self.running:
                 return errors.ELOGOFF, None
-            if len(self._waiting) >= self.config.max_queue:
+            if self.qos is None \
+                    and len(self._waiting) >= self.config.max_queue:
                 g_serving_rejected.put(1)
                 return errors.EOVERCROWDED, None
             # watermark backpressure counts queued-but-unadmitted prefill
@@ -353,8 +411,9 @@ class ServingEngine:
             # so a sharded pool can route it (route_key -> owning shard's
             # watermark; the single-pool cache ignores the key).
             seq = Sequence(prompt, max_new_tokens, stop_token, cntl, done,
-                           stream_id)
-            queued = sum(s.context_len() for s in self._waiting)
+                           stream_id, tenant_id=tenant_id,
+                           priority=priority)
+            queued = sum(s.context_len() for s in self._iter_waiting())
             need = queued + len(prompt)
             shard = None
             if self.prefix is not None:
@@ -378,12 +437,33 @@ class ServingEngine:
                     self.kv.note_rejected()
                     g_serving_rejected.put(1)
                     return errors.EOVERCROWDED, None
-            self._waiting.append(seq)
+            if self.qos is not None:
+                # weighted fair-share lane: enqueue re-evaluates the QoS
+                # admission predicate (deadline + tenant cap + limiter
+                # ceiling) under the lock — check and append are one
+                # decision
+                code = self.qos.enqueue(seq)
+                if code != 0:
+                    if code == errors.ERPCTIMEDOUT:
+                        g_serving_deadline_rejects.put(1)
+                    g_serving_rejected.put(1)
+                    return code, None
+            else:
+                self._waiting.append(seq)
             self._cv.notify()
         return 0, seq
 
+    def _iter_waiting(self):
+        """Every queued-but-unadmitted sequence (lock held): the FIFO
+        deque, or the fair-share lanes when QoS is on."""
+        if self.qos is not None:
+            return self.qos.iter_waiting()
+        return iter(self._waiting)
+
     @property
     def queue_depth(self) -> int:
+        if self.qos is not None:
+            return self.qos.total_depth()
         return len(self._waiting)
 
     @property
@@ -493,7 +573,9 @@ class ServingEngine:
                 with self._cv:
                     while (self.running and not self._waiting
                            and not self._running
-                           and not self._adopted_pending):
+                           and not self._adopted_pending
+                           and (self.qos is None
+                                or self.qos.total_depth() == 0)):
                         self._cv.wait(self.config.idle_wait_s)
                     if not self.running:
                         return
@@ -534,6 +616,8 @@ class ServingEngine:
         # budget slots, not one (a collapsed sequence is back to 1)
         budget = cfg.token_budget - sum(self._decode_cost(s)
                                         for s in self._running)
+        if self.qos is not None:
+            return self._admit_qos_locked(admitted, budget)
         while (self._waiting and len(self._running) < cfg.max_batch
                and budget >= self._prefill_cost(self._waiting[0])):
             seq = self._waiting[0]
@@ -560,6 +644,46 @@ class ServingEngine:
                     break
             self._waiting.popleft()
             budget -= self._prefill_cost(seq)
+            seq.state = STATE_RUNNING
+            self._running.append(seq)
+            admitted.append(seq)
+            g_serving_admitted.put(1)
+        return admitted
+
+    def _admit_qos_locked(self, admitted: List[Sequence],
+                          budget: int) -> List[Sequence]:
+        """Fair-share admission: each pull serves the backlogged tenant
+        with the smallest virtual clock (stride scheduling meters the
+        step's token budget by weight); the deadline is re-checked per
+        sequence exactly as the FIFO path does, and a pool-full head
+        keeps its turn for the next step's full budget."""
+        cfg = self.config
+        while len(self._running) < cfg.max_batch:
+            seq = self.qos.peek(budget, self._prefill_cost)
+            if seq is None:
+                break
+            deadline = (getattr(seq.cntl, "deadline_mono", 0.0)
+                        if seq.cntl else 0.0)
+            if deadline and time.monotonic() >= deadline:
+                self.qos.drop(seq)
+                g_serving_deadline_rejects.put(1)
+                self._finish(seq, errors.ERPCTIMEDOUT,
+                             "deadline expired in serving queue")
+                continue
+            try:
+                self._alloc_for(seq)
+            except KVCacheFull:
+                if not (self.prefix is not None
+                        and self.prefix.evict_for_admission(
+                            seq.context_len(), route_key=seq.seq_id)):
+                    break
+                try:
+                    self._alloc_for(seq)
+                except KVCacheFull:
+                    break
+            cost = self._prefill_cost(seq)
+            self.qos.commit(seq, cost)
+            budget -= cost
             seq.state = STATE_RUNNING
             self._running.append(seq)
             admitted.append(seq)
@@ -1003,6 +1127,10 @@ class ServingEngine:
             self._waiting.clear()
             self._running = []
             self._adopted_pending.clear()
+            if self.qos is not None:
+                for seq in list(self.qos.iter_waiting()):
+                    self.qos.drop(seq)
+                    pending.append(seq)
         for seq in pending:
             self._finish(seq, code, reason)
 
@@ -1047,4 +1175,6 @@ class ServingEngine:
             "spec": (dict(self.spec_stats.snapshot(),
                           k_max=self.config.spec_k)
                      if self.spec_stats is not None else None),
+            "qos": (self.qos.snapshot()
+                    if self.qos is not None else None),
         }

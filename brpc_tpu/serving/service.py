@@ -81,10 +81,15 @@ class LlmServingService(Service):
         meta = getattr(cntl, "_srv_meta", None)
         if meta is not None and meta.stream_settings.stream_id:
             stream_id = stream_accept(cntl, StreamOptions())
+        # QoS identity decoded off RequestMeta by the dispatch path; the
+        # engine bills the named tenant's fair-share lane and sheds the
+        # low-priority lanes first under overload
         code, _seq = self.engine.submit(
             prompt, request.max_new_tokens or 16,
             stop_token=request.stop_token, cntl=cntl, done=done,
-            stream_id=stream_id)
+            stream_id=stream_id,
+            tenant_id=getattr(cntl, "tenant_id", ""),
+            priority=getattr(cntl, "priority", 0))
         if code != 0:
             cntl.set_failed(code, "serving admission rejected")
             return serving_pb2.GenerateResponse()

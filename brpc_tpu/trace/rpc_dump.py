@@ -126,10 +126,11 @@ class RpcDumper:
             "span_id": f"{req.span_id:016x}",
             "log_id": int(req.log_id),
             "timeout_ms": int(req.timeout_ms or 0),
-            # RequestMeta carries no priority field yet; the slot is
-            # reserved so overload-control PRs can stamp it without a
-            # format bump
-            "priority": 0,
+            # QoS identity: which fair-share lane the request billed
+            # against and how protected it is — rpc_replay re-stamps both
+            # so replayed overload waves shed the same tenants
+            "tenant": req.tenant_id,
+            "priority": int(req.priority),
             "_meta": meta,
             "_body": bytes(body),
         }

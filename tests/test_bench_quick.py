@@ -349,6 +349,71 @@ def test_spec_phase_skips_others(spec_bench_run):
     assert "# device lane" not in err
 
 
+@pytest.fixture(scope="module")
+def qos_bench_run():
+    env = dict(os.environ,
+               BENCH_QUICK="1",
+               BENCH_PHASES="qos",
+               BENCH_SKIP_DEVICE="1",
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                          capture_output=True, text=True, timeout=300,
+                          cwd=REPO, env=env)
+    assert proc.returncode == 0, \
+        f"bench.py failed rc={proc.returncode}:\n{proc.stderr[-2000:]}"
+    return proc
+
+
+def test_qos_lane_json_metrics(qos_bench_run):
+    """The qos phase emits exactly its two machine-readable lines: the
+    protected tenant's p99 under the best-effort flood (with its
+    unloaded and FIFO-engine comparators) and the flood's shed rate."""
+    rows = [json.loads(l) for l in qos_bench_run.stdout.splitlines()
+            if l.startswith("{")]
+    by = {r["metric"]: r for r in rows}
+    assert set(by) == {"serving_qos_protected_p99_ms",
+                       "serving_qos_shed_rate"}, qos_bench_run.stdout
+    p99 = by["serving_qos_protected_p99_ms"]
+    assert p99["unit"] == "ms" and p99["value"] > 0, p99
+    assert p99["unloaded_ms"] > 0 and p99["fifo_ms"] > 0, p99
+
+
+def test_qos_protects_p99_vs_fifo(qos_bench_run):
+    """The acceptance floor: under the same flood the fair-share engine
+    must hold the protected tenant's p99 to a fraction of the FIFO
+    engine's — on FIFO, prod queues behind the whole best-effort wave;
+    with QoS, weighted admission interleaves it ahead."""
+    rows = [json.loads(l) for l in qos_bench_run.stdout.splitlines()
+            if l.startswith("{")]
+    p99 = [r for r in rows
+           if r["metric"] == "serving_qos_protected_p99_ms"][0]
+    assert p99["fifo_ratio"] >= 1.5, p99
+    lane = [l for l in qos_bench_run.stderr.splitlines()
+            if l.startswith("# serving qos:")]
+    assert lane, qos_bench_run.stderr[-2000:]
+
+
+def test_qos_sheds_best_effort_flood(qos_bench_run):
+    """The flood past the batch tenant's queue cap must shed
+    EOVERCROWDED at admission (the FIFO engine, with no per-tenant cap,
+    absorbs the whole wave into its queue)."""
+    rows = [json.loads(l) for l in qos_bench_run.stdout.splitlines()
+            if l.startswith("{")]
+    shed = [r for r in rows if r["metric"] == "serving_qos_shed_rate"][0]
+    assert shed["unit"] == "ratio", shed
+    assert shed["shed"] > 0 and shed["sent"] > 0, shed
+    assert shed["value"] >= 0.3, shed
+    assert shed["fifo_shed"] == 0, shed
+
+
+def test_qos_phase_skips_others(qos_bench_run):
+    err = qos_bench_run.stderr
+    assert "# serving lane:" not in err
+    assert "# serving spec:" not in err
+    assert "# tpu:// sweep" not in err
+    assert "# batch lane (" not in err
+
+
 def test_zero_copy_counters_emitted(bench_run):
     err = bench_run.stderr
     zc = [l for l in err.splitlines()

@@ -19,7 +19,7 @@ EXPECTED_RULES = {
     "named-thread", "cross-process-ownership", "metric-churn",
     "no-per-token-host-sync", "no-per-op-step-dispatch",
     "cow-before-write", "quiesce-before-migrate",
-    "draft-no-device-sync",
+    "draft-no-device-sync", "shed-before-queue",
 }
 
 
@@ -1072,6 +1072,77 @@ class TestDraftNoDeviceSync:
 
             def draft_tokens(history, k):
                 return history[-k:]
+            """}, rules=self.RULE)
+        assert res.clean
+        assert len(res.suppressed) == 1
+
+
+class TestShedBeforeQueue:
+    RULE = ["shed-before-queue"]
+
+    def test_unchecked_append_fires(self, tmp_path):
+        res = _lint(tmp_path, {"serving/engine.py": """\
+            def submit(self, seq):
+                self._waiting.append(seq)
+                self._cv.notify()
+            """}, rules=self.RULE)
+        assert [f.rule for f in res.findings] == ["shed-before-queue"]
+        assert res.findings[0].line == 2
+        assert "admission" in res.findings[0].message
+
+    def test_tenant_lane_append_fires(self, tmp_path):
+        # the per-tenant lanes are waiting queues too — a scheduler
+        # helper that grows one without re-checking is the same bug
+        res = _lint(tmp_path, {"serving/qos.py": """\
+            def requeue(self, t, seq):
+                t.waiting.append(seq)
+            """}, rules=self.RULE)
+        assert not res.clean
+
+    def test_admission_check_guard_passes(self, tmp_path):
+        res = _lint(tmp_path, {"serving/qos.py": """\
+            def enqueue(self, seq):
+                code = self.admission_check(seq.tenant_id, seq.priority)
+                if code != 0:
+                    return code
+                t = self.tenant(seq.tenant_id)
+                t.waiting.append(seq)
+                return 0
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_can_admit_guard_passes(self, tmp_path):
+        # the pre-QoS watermark check also satisfies the contract: the
+        # append is still behind an admission predicate
+        res = _lint(tmp_path, {"serving/engine.py": """\
+            def submit(self, seq, need):
+                if not self.kv.can_admit(need):
+                    return 1
+                self._waiting.append(seq)
+                return 0
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_other_queues_exempt(self, tmp_path):
+        # only the waiting lanes are admission-gated; adoption/pending
+        # lists have their own ownership contracts
+        res = _lint(tmp_path, {"serving/engine.py": """\
+            def adopt(self, seq):
+                self._adopted_pending.append(seq)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_same_code_outside_scope_passes(self, tmp_path):
+        res = _lint(tmp_path, {"rpc/stream.py": """\
+            def push(self, frame):
+                self._waiting.append(frame)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_suppression_honored(self, tmp_path):
+        res = _lint(tmp_path, {"serving/debug.py": """\
+            def inject(self, seq):
+                self._waiting.append(seq)  # tpulint: disable=shed-before-queue
             """}, rules=self.RULE)
         assert res.clean
         assert len(res.suppressed) == 1

@@ -44,6 +44,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from brpc_tpu.metrics.latency_recorder import LatencyRecorder
 from brpc_tpu.policy import compress as _compress
 from brpc_tpu.rpc import Channel, ChannelOptions, Controller, MethodDescriptor
+from brpc_tpu.rpc import errors as _errors
 from brpc_tpu.rpc.channel import RawMessage
 
 
@@ -51,15 +52,18 @@ class _ReplayItem:
     """One decoded dump record, ready to fire repeatedly."""
 
     __slots__ = ("md", "payload", "attachment", "trace_id",
-                 "parent_span_id", "offset_s")
+                 "parent_span_id", "offset_s", "tenant", "priority")
 
-    def __init__(self, md, payload, attachment, trace_id, parent_span_id):
+    def __init__(self, md, payload, attachment, trace_id, parent_span_id,
+                 tenant="", priority=0):
         self.md = md
         self.payload = payload
         self.attachment = attachment
         self.trace_id = trace_id
         self.parent_span_id = parent_span_id
         self.offset_s = 0.0
+        self.tenant = tenant
+        self.priority = priority
 
 
 def load_items(dump_path: str):
@@ -91,11 +95,37 @@ def load_items(dump_path: str):
             print(f"undecodable record skipped: {e}", file=sys.stderr)
             continue
         item = _ReplayItem(md, payload, attachment, rec.trace_id,
-                           rec.span_id)
+                           rec.span_id,
+                           tenant=str(rec.info.get("tenant", "")),
+                           priority=int(rec.info.get("priority", 0)))
         if rec.ts_us > 0.0:
             item.offset_s = max(0.0, (rec.ts_us - t0) / 1e6)
         items.append(item)
     return items, skipped
+
+
+class _TenantStats:
+    """Per-tenant slice of the replay outcome: QoS sheds (EOVERCROWDED)
+    counted apart from other failures so an overload replay can assert
+    WHO got shed, not just how many calls failed."""
+
+    __slots__ = ("sent", "ok", "fail", "shed", "recorder")
+
+    def __init__(self):
+        self.sent = 0
+        self.ok = 0
+        self.fail = 0
+        self.shed = 0
+        self.recorder = LatencyRecorder()
+
+    def as_dict(self):
+        r = self.recorder
+        return {
+            "sent": self.sent, "ok": self.ok, "fail": self.fail,
+            "shed": self.shed,
+            "p50_us": round(r.latency_percentile(0.5), 1) if self.ok else 0.0,
+            "p99_us": round(r.latency_percentile(0.99), 1) if self.ok else 0.0,
+        }
 
 
 class _Stats:
@@ -104,19 +134,39 @@ class _Stats:
         self.sent = 0
         self.ok = 0
         self.fail = 0
+        self.shed = 0
         self.recorder = LatencyRecorder()
         self.first_error = ""
+        self.tenants = {}
 
-    def settle(self, cntl, latency_us: float) -> None:
+    def _tenant(self, tenant: str) -> _TenantStats:
+        ts = self.tenants.get(tenant)
+        if ts is None:
+            ts = self.tenants[tenant] = _TenantStats()
+        return ts
+
+    def mark_sent(self, tenant: str) -> None:
         with self.lock:
+            self.sent += 1
+            self._tenant(tenant).sent += 1
+
+    def settle(self, cntl, latency_us: float, tenant: str = "") -> None:
+        with self.lock:
+            ts = self._tenant(tenant)
             if cntl.failed():
                 self.fail += 1
+                ts.fail += 1
+                if cntl.error_code == _errors.EOVERCROWDED:
+                    self.shed += 1
+                    ts.shed += 1
                 if not self.first_error:
                     self.first_error = (f"[E{cntl.error_code}] "
                                         f"{cntl.error_text()}")
             else:
                 self.ok += 1
+                ts.ok += 1
                 self.recorder.record(latency_us)
+                ts.recorder.record(latency_us)
 
 
 def main(argv=None) -> int:
@@ -144,6 +194,15 @@ def main(argv=None) -> int:
                         "(0 disables)")
     p.add_argument("--no-trace-tag", action="store_true",
                    help="do not reuse recorded trace ids on replayed calls")
+    p.add_argument("--tenant-override", default=None,
+                   help="replay every record under this QoS tenant instead "
+                        "of the recorded one (synthetic-tenant probing)")
+    p.add_argument("--priority-override", type=int, default=None,
+                   help="replay every record at this QoS priority instead "
+                        "of the recorded one")
+    p.add_argument("--json-out", default=None,
+                   help="write the final totals + per-tenant stats as JSON "
+                        "to this file (machine-readable overload gate)")
     p.add_argument("--protocol", default="trpc_std")
     args = p.parse_args(argv)
 
@@ -194,6 +253,16 @@ def main(argv=None) -> int:
     def issue(item: _ReplayItem, pass_num: int) -> None:
         cntl = Controller()
         cntl.request_attachment = item.attachment
+        # QoS identity rides with the replay: recorded tenant/priority by
+        # default, overridable to probe synthetic tenants against a live
+        # fair-share config
+        tenant = (args.tenant_override if args.tenant_override is not None
+                  else item.tenant)
+        priority = (args.priority_override
+                    if args.priority_override is not None
+                    else item.priority)
+        cntl.tenant_id = tenant
+        cntl.priority = priority
         if item.trace_id and not args.no_trace_tag:
             # replayed span: same trace as the recording, hung under the
             # recorded client span so the stitched tree shows the pair
@@ -206,9 +275,11 @@ def main(argv=None) -> int:
         t_start = time.perf_counter_ns()
 
         def on_done(c):
-            stats.settle(c, (time.perf_counter_ns() - t_start) / 1000.0)
+            stats.settle(c, (time.perf_counter_ns() - t_start) / 1000.0,
+                         tenant)
             inflight.release()
 
+        stats.mark_sent(tenant)
         try:
             channel.call_method(item.md, RawMessage(item.payload),
                                 response=RawMessage(), controller=cntl,
@@ -217,6 +288,7 @@ def main(argv=None) -> int:
             inflight.release()
             with stats.lock:
                 stats.fail += 1
+                stats._tenant(tenant).fail += 1
                 if not stats.first_error:
                     stats.first_error = str(e)
 
@@ -236,8 +308,6 @@ def main(argv=None) -> int:
             if fire_at > now:
                 time.sleep(fire_at - now)
             inflight.acquire()
-            with stats.lock:
-                stats.sent += 1
             issue(item, pass_num)
         if args.loop > 0 and pass_num >= args.loop:
             break
@@ -250,13 +320,36 @@ def main(argv=None) -> int:
 
     elapsed = time.monotonic() - start
     qps = stats.sent / max(1e-9, elapsed)
-    print(f"replayed ok {stats.ok} failed {stats.fail} skipped {skipped} "
+    print(f"replayed ok {stats.ok} failed {stats.fail} "
+          f"shed {stats.shed} skipped {skipped} "
           f"passes {pass_num} elapsed {elapsed:.2f}s qps {qps:.0f}")
     if stats.ok:
         r = stats.recorder
         print(f"latency_avg_us {r.latency():.1f} "
               f"p50_us {r.latency_percentile(0.5):.1f} "
               f"p99_us {r.latency_percentile(0.99):.1f}")
+    for name in sorted(stats.tenants):
+        td = stats.tenants[name].as_dict()
+        print(f"tenant {name or '-'} sent {td['sent']} ok {td['ok']} "
+              f"shed {td['shed']} fail {td['fail']} "
+              f"p50_us {td['p50_us']:.1f} p99_us {td['p99_us']:.1f}")
+    if args.json_out:
+        import json
+        payload = {
+            "sent": stats.sent, "ok": stats.ok, "fail": stats.fail,
+            "shed": stats.shed, "skipped": skipped,
+            "passes": pass_num, "elapsed_s": round(elapsed, 3),
+            "qps": round(qps, 1),
+            "p50_us": (round(stats.recorder.latency_percentile(0.5), 1)
+                       if stats.ok else 0.0),
+            "p99_us": (round(stats.recorder.latency_percentile(0.99), 1)
+                       if stats.ok else 0.0),
+            "tenants": {name: ts.as_dict()
+                        for name, ts in sorted(stats.tenants.items())},
+        }
+        with open(args.json_out, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
     if stats.fail and stats.first_error:
         print(f"first_error {stats.first_error}", file=sys.stderr)
     return 0 if stats.fail == 0 else 1
