@@ -1261,3 +1261,102 @@ def rule_shed_before_queue(pkg: Package) -> List[Finding]:
                     f"queueing under overload; consult "
                     f"can_admit/admission_check before the append"))
     return out
+
+
+# --------------------------------------------------------------------------
+# Rule 18: budget-gated-scrape
+# --------------------------------------------------------------------------
+# The fleet plane's politeness contract (docs/observability.md §Fleet
+# observer): a periodic scrape loop in fleet/ multiplies by the number of
+# members AND the number of observers, so it must stay retunable at
+# runtime (re-read a reloadable interval flag every round — a hardcoded
+# sleep can only be changed by a restart mid-incident) and it must draw
+# each round from the shared metrics Collector budget
+# (collector_max_samples_per_second), so N observers can never stampede a
+# fleet the way unbudgeted pollers famously do. The rule fires on any
+# sleep/wait loop in fleet/ missing either leg.
+
+_FLEET_SCOPE_PREFIXES = ("fleet/",)
+
+
+def _sleep_loops(func: ast.AST) -> List[ast.While]:
+    """While-loops that park the thread: any sleep()/wait() call reachable
+    from the loop node (the loop test counts — `stop.wait(...)` as the
+    condition is the canonical shape)."""
+    loops: List[ast.While] = []
+    for node in ast.walk(func):
+        if not isinstance(node, ast.While):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                name = attr_chain(sub.func)
+                if name is not None and \
+                        name.split(".")[-1] in ("sleep", "wait"):
+                    loops.append(node)
+                    break
+    return loops
+
+
+def _interval_flag_read(func: ast.AST) -> bool:
+    """A flags.get(...) / _flags.get(...) call anywhere in the function."""
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            name = attr_chain(node.func)
+            if name is None:
+                continue
+            parts = name.split(".")
+            if parts[-1] == "get" and any("flags" in p for p in parts[:-1]):
+                return True
+    return False
+
+
+def _budget_consulted(func: ast.AST) -> bool:
+    """An ask_to_be_sampled(...) call anywhere in the function. Matched
+    on the final attribute directly (not attr_chain) so the canonical
+    ``global_collector().ask_to_be_sampled()`` — a chain rooted in a
+    call — still counts."""
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) \
+                    and fn.attr == "ask_to_be_sampled":
+                return True
+            if isinstance(fn, ast.Name) and fn.id == "ask_to_be_sampled":
+                return True
+    return False
+
+
+@register_rule(
+    "budget-gated-scrape",
+    "periodic (sleep/wait) loops in fleet/ must re-read a reloadable "
+    "interval flag and draw from the shared Collector budget "
+    "(ask_to_be_sampled) in the same function — unbudgeted fixed-rate "
+    "scrapers stampede fleets")
+def rule_budget_gated_scrape(pkg: Package) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in pkg.files:
+        if not in_scope(sf.rel, prefixes=_FLEET_SCOPE_PREFIXES):
+            continue
+        for func, cls in iter_functions(sf.tree):
+            loops = _sleep_loops(func)
+            if not loops:
+                continue
+            missing = []
+            if not _interval_flag_read(func):
+                missing.append("a reloadable interval flag read "
+                               "(flags.get)")
+            if not _budget_consulted(func):
+                missing.append("a Collector budget draw "
+                               "(ask_to_be_sampled)")
+            if not missing:
+                continue
+            where = f"{cls}.{func.name}" if cls else func.name
+            for loop in loops:
+                out.append(Finding(
+                    "budget-gated-scrape", sf.rel, loop.lineno,
+                    f"{where}() runs a periodic loop without "
+                    f"{' or '.join(missing)} — fleet scrape loops "
+                    f"multiply by members × observers and must stay "
+                    f"retunable and under "
+                    f"collector_max_samples_per_second"))
+    return out

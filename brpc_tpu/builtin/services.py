@@ -149,12 +149,19 @@ def vars_service(server, http: HttpMessage):
                     f"1s[-10:]={sec[-10:]} (?series=json, ?format=svg)\n")
         return 200, CONTENT_TEXT, out
     if http.query.get("series") == "json":
+        from brpc_tpu.fleet.merge import snapshot_vars
+
         glob = http.query.get("name", "*")
         dump = global_series().dump(glob)
         return 200, CONTENT_JSON, json.dumps(
             {"workers": getattr(server, "shard_worker_count", 0)
              if server is not None else 0,
-             "series": dump}) + "\n"
+             "series": dump,
+             # exact last values + merge op + prometheus type per var —
+             # the fleet observer's scrape unit (Adder sums over members
+             # stay exact because this is the live value, not a series
+             # sample)
+             "vars": snapshot_vars()}) + "\n"
     body = "".join(f"{k} : {v}\n" for k, v in snapshot.items())
     return 200, CONTENT_TEXT, body
 
@@ -370,6 +377,7 @@ def rpcz_service(server, http: HttpMessage):
         ?method=substr                substring match on service.method
         ?min_latency_us=N             only slower spans
         ?error_only=1                 only spans with a non-zero error code
+        ?retained=tail                only spans tail retention committed
         ?format=json                  structured export (tools/trace_view.py)
     GET /rpcz/<trace_id hex>          every span of one trace
         ?format=json                  whole-trace JSON export
@@ -400,6 +408,7 @@ def rpcz_service(server, http: HttpMessage):
         method=http.query.get("method", ""),
         min_latency_us=min_latency_us,
         error_only=http.query.get("error_only", "") in ("1", "true"),
+        retained=http.query.get("retained", ""),
     )
     if as_json:
         body = json.dumps({"spans": [s.to_dict() for s in recent]}, indent=2)
@@ -548,6 +557,17 @@ def dump_service(server, http: HttpMessage):
         "rotations": _dump.g_dump_rotations.get_value(),
         "errors": _dump.g_dump_errors.get_value(),
     }
+    retainer = getattr(server, "tail_retainer", None) \
+        if server is not None else None
+    if retainer is not None:
+        from brpc_tpu.trace import tail as _tail
+
+        state["tail"] = {
+            **retainer.state(),
+            "retained": _tail.g_dump_tail_retained.get_value(),
+            "dropped": _tail.g_dump_tail_dropped.get_value(),
+            "shed": _tail.g_dump_tail_shed.get_value(),
+        }
     dumper = getattr(server, "rpc_dumper", None) if server is not None else None
     if dumper is not None:
         st = dumper.state()
@@ -567,6 +587,13 @@ def dump_service(server, http: HttpMessage):
              f"sampled: {state['sampled']}  skipped: {state['skipped']}  "
              f"errors: {state['errors']}",
              f"bytes: {state['bytes']}  rotations: {state['rotations']}"]
+    if "tail" in state:
+        t = state["tail"]
+        lines.append(
+            f"tail: enabled={t['enabled']} held={t['held']} "
+            f"retained={t['retained']} dropped={t['dropped']} "
+            f"shed={t['shed']} slow_x={t['slow_x']} hold_s={t['hold_s']} "
+            f"max_per_sec={t['max_per_sec']}")
     if dumper is None:
         lines.append("")
         lines.append("this server has no dumper "
@@ -746,6 +773,98 @@ def serving_service(server, http: HttpMessage):
     return 200, CONTENT_TEXT, "\n".join(out) + "\n"
 
 
+# --------------------------------------------------------------------- fleet
+def fleet_service(server, http: HttpMessage):
+    """Fleet observer state: per-member liveness/staleness, cluster_* var
+    coverage, serving shard-map union, fleet-wide firing rules.
+
+    GET /fleet                      member table + cluster summary
+        ?format=json                structured snapshot
+    GET /fleet/trace/<trace_id>     retained trace stitched across live
+                                    members (merge_trace_docs), JSON
+    """
+    from brpc_tpu.fleet.observer import global_observer
+
+    obs = global_observer()
+    sub = _sub_path(http)
+    if sub.startswith("trace/"):
+        if obs is None:
+            return 404, CONTENT_TEXT, "no fleet observer running\n"
+        doc = obs.fleet_trace(sub[len("trace/"):])
+        if not doc.get("spans"):
+            return 404, CONTENT_TEXT, "no spans on any live member\n"
+        return 200, CONTENT_JSON, json.dumps(doc, indent=2) + "\n"
+    if sub:
+        return 404, CONTENT_TEXT, f"no /fleet/{sub}\n"
+    if obs is None:
+        return 200, CONTENT_TEXT, (
+            "no fleet observer running\n"
+            "(FleetObserver('list://h1:p1,h2:p2').start() then "
+            "set_global_observer(obs))\n")
+    doc = obs.to_dict()
+    if http.query.get("format", "") == "json":
+        return 200, CONTENT_JSON, json.dumps(doc, indent=2) + "\n"
+    lines = [f"fleet: {doc['live']}/{len(doc['members'])} members live, "
+             f"{doc['cluster_vars']} cluster vars, "
+             f"scrape interval {doc['interval_s']:g}s",
+             "",
+             f"{'member':24} {'state':7} {'age_s':>8} {'ok':>6} "
+             f"{'fail':>6} {'vars':>6}  firing"]
+    for m in doc["members"]:
+        state = "live" if m["live"] else (
+            "stale" if m["stale"] else "down")
+        age = f"{m['age_s']:.1f}" if m["age_s"] is not None else "-"
+        lines.append(
+            f"{m['addr']:24} {state:7} {age:>8} {m['scrapes_ok']:>6} "
+            f"{m['scrapes_failed']:>6} {m['vars']:>6}  "
+            f"{','.join(m['firing']) or '-'}")
+        if m["last_error"]:
+            lines.append(f"  last_error: {m['last_error']}")
+    if doc["serving_shards"]:
+        lines.append("")
+        lines.append("== serving shard map (union) ==")
+        for key, shard in sorted(doc["serving_shards"].items()):
+            lines.append(f"{key} -> {shard}")
+    return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
+
+
+# ----------------------------------------------------------------------- slo
+def slo_service(server, http: HttpMessage):
+    """SLO objectives and their error-budget burn rates (?format=json)."""
+    from brpc_tpu.fleet.slo import global_slo
+
+    doc = global_slo().to_dict()
+    if http.query.get("format", "") == "json":
+        return 200, CONTENT_JSON, json.dumps(doc, indent=2) + "\n"
+    if not doc["objectives"]:
+        return 200, CONTENT_TEXT, (
+            "no slo objectives installed\n"
+            "(set the slo_objectives flag: "
+            "'name:var=<stem>,bound_ms=...,objective=...')\n")
+    lines = [f"burn threshold: {doc['threshold']:g}  "
+             f"(series source: {doc['source']})",
+             "",
+             f"{'objective':20} {'burn':>8} {'fast':>8} {'slow':>8} "
+             f"{'budget':>8}  rule"]
+    for o in doc["objectives"]:
+        rule = o.get("rule") or {}
+        lines.append(
+            f"{o['name']:20} {o['burn']:>8.3f} {o['burn_fast']:>8.3f} "
+            f"{o['burn_slow']:>8.3f} {o['budget_left']:>8.3f}  "
+            f"{rule.get('state', 'no rule')}")
+        bound = o["latency_bound_us"]
+        parts = []
+        if o["latency_var"] and bound:
+            parts.append(f"p99({o['latency_var']}) <= {bound:g}us")
+        if o["errors_var"]:
+            parts.append(f"errors({o['errors_var']}/{o['total_var']})")
+        tenant = f" tenant={o['tenant']}" if o["tenant"] else ""
+        lines.append(f"  {' and '.join(parts)} for >= "
+                     f"{1.0 - o['objective']:.2%} of seconds{tenant} "
+                     f"(windows {o['fast_window_s']}s/{o['slow_window_s']}s)")
+    return 200, CONTENT_TEXT, "\n".join(lines) + "\n"
+
+
 # -------------------------------------------------------------------- logoff
 def logoff_service(server, http: HttpMessage):
     if server is None:
@@ -790,3 +909,9 @@ register_builtin("serving", serving_service,
                  "serving engines: batch occupancy, kv watermark, queue "
                  "depth, step timings, qos tenant lanes, per-shard "
                  "occupancy/latency (?format=json)")
+register_builtin("fleet", fleet_service,
+                 "fleet observer: member liveness, cluster_* merge, "
+                 "serving shard union (/fleet/trace/<tid>, ?format=json)")
+register_builtin("slo", slo_service,
+                 "slo objectives and error-budget burn rates "
+                 "(?format=json)")

@@ -165,6 +165,11 @@ class WatchRegistry:
         self._rules: Dict[str, WatchRule] = {}
         self._lock = threading.Lock()
         self._vars = []
+        # called as hook(rule, new_state) on every state transition, after
+        # the transition span — tail retention correlates in-flight traces
+        # with firings through this. Hooks must not raise (guarded anyway)
+        # and must not block: they run inside the sampler tick.
+        self.transition_hooks: List[Callable[[WatchRule, str], None]] = []
 
     def add(self, rule: WatchRule) -> WatchRule:
         with self._lock:
@@ -209,6 +214,11 @@ class WatchRegistry:
             span.end(error_code=1 if new_state == STATE_FIRING else 0)
         except Exception:
             pass
+        for hook in list(self.transition_hooks):
+            try:
+                hook(rule, new_state)
+            except Exception:
+                pass
 
     # -------------------------------------------------------------exposure
     def expose_vars(self) -> None:

@@ -230,10 +230,15 @@ class Server:
         self._master_service = None     # catch-all generic service
         self._shard_plane = None        # sharded dispatch plane (shard/)
         self.rpc_dumper = None
+        self.tail_retainer = None
         if self.options.rpc_dump_dir:
             from brpc_tpu.trace.rpc_dump import RpcDumper
+            from brpc_tpu.trace.tail import TailRetainer
 
             self.rpc_dumper = RpcDumper(self.options.rpc_dump_dir)
+            # settle-time retention front of the same dump stream; inert
+            # until the reloadable rpc_dump_tail flag turns it on
+            self.tail_retainer = TailRetainer(self.rpc_dumper)
 
     @property
     def shard_worker_count(self) -> int:
@@ -499,6 +504,10 @@ class Server:
         if self._native_lid is not None:
             self._native_dp.teardown_listener(self._native_lid)
             self._native_lid = None
+        if self.tail_retainer is not None:
+            # detach the watch transition hook; held-but-undecided traces
+            # drop (the process is going away — nothing left to correlate)
+            self.tail_retainer.close()
         self._running = False
 
     @property

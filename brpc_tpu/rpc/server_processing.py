@@ -187,6 +187,9 @@ def process_rpc_request(protocol, msg, server) -> None:
     # v2 dump record opened at dispatch, committed at settle so it carries
     # the span's COMPLETE phase timeline (rpc_dump.RpcDumper.begin/commit)
     pending_dump = [None]
+    # tail retention twin: opened when the head sampler passed but tail
+    # mode is on — the retention decision happens at settle (trace/tail.py)
+    pending_tail = [None]
 
     def _settle(error_code: int) -> None:
         if settled[0]:
@@ -200,6 +203,11 @@ def process_rpc_request(protocol, msg, server) -> None:
             dumper = getattr(server, "rpc_dumper", None)
             if dumper is not None:
                 dumper.commit(pending_dump[0], cntl.span, error_code)
+        elif pending_tail[0] is not None:
+            retainer = getattr(server, "tail_retainer", None)
+            if retainer is not None:
+                retainer.offer(pending_tail[0], cntl.span, error_code,
+                               entry.latency.latency_percentile(0.99))
 
     responded = [False]
 
@@ -254,6 +262,10 @@ def process_rpc_request(protocol, msg, server) -> None:
         dumper = getattr(server, "rpc_dumper", None)
         if dumper is not None and dumper.ask_to_be_sampled():
             pending_dump[0] = dumper.begin(meta, payload + attachment)
+        elif dumper is not None:
+            retainer = getattr(server, "tail_retainer", None)
+            if retainer is not None and retainer.enabled():
+                pending_tail[0] = dumper.begin(meta, payload + attachment)
         checksum_ok = protocol.verify_checksum(meta, payload)
         if cntl.span is not None:
             # attachment split + checksum walk the whole body: wire-format
@@ -725,10 +737,18 @@ def fast_process_request(item) -> None:
     # attachment split so the record's body is the whole wire payload
     dumper = server.rpc_dumper
     pending_dump = None
-    if dumper is not None and dumper.ask_to_be_sampled():
-        pending_dump = dumper.begin(
-            _rebuild_meta(svc, meth, cid, attempt, att_size, log_id,
-                          trace_id, span_id, timeout_ms), body)
+    pending_tail = None
+    if dumper is not None:
+        if dumper.ask_to_be_sampled():
+            pending_dump = dumper.begin(
+                _rebuild_meta(svc, meth, cid, attempt, att_size, log_id,
+                              trace_id, span_id, timeout_ms), body)
+        else:
+            retainer = server.tail_retainer
+            if retainer is not None and retainer.enabled():
+                pending_tail = dumper.begin(
+                    _rebuild_meta(svc, meth, cid, attempt, att_size, log_id,
+                                  trace_id, span_id, timeout_ms), body)
 
     if att_size:
         cntl.request_attachment = body[len(body) - att_size:]
@@ -736,6 +756,7 @@ def fast_process_request(item) -> None:
 
     done = _FastDone(dp, conn, cid, attempt, cntl, entry, server, start_us)
     done.pending_dump = pending_dump
+    done.pending_tail = pending_tail
 
     try:
         _set_phase("parse")
@@ -785,7 +806,8 @@ class _FastDone:
     allocates once and runs on every RPC)."""
 
     __slots__ = ("dp", "conn", "cid", "attempt", "cntl", "entry", "server",
-                 "start_us", "responded", "settled", "pending_dump")
+                 "start_us", "responded", "settled", "pending_dump",
+                 "pending_tail")
 
     def __init__(self, dp, conn, cid, attempt, cntl, entry, server,
                  start_us):
@@ -800,6 +822,7 @@ class _FastDone:
         self.responded = False
         self.settled = False
         self.pending_dump = None
+        self.pending_tail = None
 
     def __call__(self, response=None) -> None:
         if self.responded:
@@ -842,6 +865,11 @@ class _FastDone:
             dumper = self.server.rpc_dumper
             if dumper is not None:
                 dumper.commit(self.pending_dump, span, error_code)
+        elif self.pending_tail is not None:
+            retainer = self.server.tail_retainer
+            if retainer is not None:
+                retainer.offer(self.pending_tail, span, error_code,
+                               self.entry.latency.latency_percentile(0.99))
 
 
 def _send_response(protocol, sock, request_meta, code, text, payload,

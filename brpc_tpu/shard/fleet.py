@@ -18,75 +18,33 @@ truth. This module closes that gap over the existing stats lane:
   Fleet vars carry a Prometheus ``# HELP`` naming the merge, and
   ``fleet_shard_workers`` says how many workers the aggregate covers.
 
+The merge semantics themselves (op derivation, op arithmetic, the snapshot
+walk) live in :mod:`brpc_tpu.fleet.merge` since the fleet observer merges
+the same way across *servers*; this module keeps the parent-side store and
+the historical names.
+
 Payloads are UTF-8 JSON of flat scalars — flat bytes over the ring, no
 pickle, same as W_STATS.
 """
 
 from __future__ import annotations
 
-import json
 import threading
 from typing import Dict, List
 
+from brpc_tpu.fleet.merge import (  # noqa: F401  (re-exported names)
+    OP_AVG,
+    OP_MAX,
+    OP_MIN,
+    OP_SUM,
+    OP_WAVG_QPS,
+    MergedVar as _FleetVar,
+    merge_op as _merge_op,
+    merge_values,
+    qps_weight_name,
+    worker_snapshot,
+)
 from brpc_tpu.metrics.status import PassiveStatus
-from brpc_tpu.metrics.variable import exposed_variables
-
-# merge ops carried in the snapshot
-OP_SUM = "sum"
-OP_MAX = "max"
-OP_MIN = "min"
-OP_AVG = "avg"
-OP_WAVG_QPS = "wavg_qps"   # qps-weighted mean (windowed latency averages)
-
-
-def _merge_op(name: str, var) -> str:
-    """Pick the cross-worker merge op for one variable."""
-    if getattr(var, "prometheus_type", "gauge") == "counter":
-        return OP_SUM
-    if name.endswith(("_qps", "_count", "_second", "_errors", "_error")):
-        return OP_SUM
-    if "_latency_p" in name:
-        # per-worker percentiles don't compose exactly; max is the
-        # conservative fleet upper bound (documented in docs/observability)
-        return OP_MAX
-    tokens = name.split("_")
-    if "max" in tokens:        # max_latency et al, before the _latency check
-        return OP_MAX
-    if "min" in tokens:
-        return OP_MIN
-    if name.endswith("_latency"):
-        return OP_WAVG_QPS
-    return OP_AVG
-
-
-def worker_snapshot(index: int) -> bytes:
-    """The W_VARS payload: every exposed numeric var of this process."""
-    out = {}
-    for name, var in exposed_variables():
-        try:
-            value = var.get_value()
-        except Exception:
-            continue
-        if isinstance(value, bool) or not isinstance(value, (int, float)):
-            continue
-        ptype = getattr(var, "prometheus_type", "gauge")
-        out[name] = [_merge_op(name, var), ptype, value]
-    return json.dumps({"index": index, "vars": out}).encode()
-
-
-class _FleetVar(PassiveStatus):
-    """PassiveStatus with exposition metadata slots (type + HELP) and a
-    series opt-out knob — plain attrs read by prometheus_text and the
-    series sweep."""
-
-    def __init__(self, fn, ptype: str = "gauge", help_text: str = "",
-                 opt_out: bool = False):
-        super().__init__(fn)
-        self.prometheus_type = ptype
-        if help_text:
-            self.prometheus_help = help_text
-        if opt_out:
-            self.series_opt_out = True
 
 
 class FleetVars:
@@ -105,6 +63,7 @@ class FleetVars:
     # ------------------------------------------------------------ ingest
     def on_snapshot(self, index: int, payload: bytes) -> None:
         try:
+            import json
             doc = json.loads(payload.decode())
             snap = {str(name): (str(rec[0]), str(rec[1]), rec[2])
                     for name, rec in doc["vars"].items()
@@ -148,21 +107,12 @@ class FleetVars:
                 op = recs[0][1][0]
                 values = [rec[2] for _, rec in recs]
                 if op == OP_WAVG_QPS:
-                    wname = name[: -len("_latency")] + "_qps"
+                    wname = qps_weight_name(name)
                     weights = [self._snaps[i].get(wname, (0, 0, 0))[2]
                                for i, _ in recs]
                 else:
                     weights = None
-            if op == OP_SUM:
-                return sum(values)
-            if op == OP_MAX:
-                return max(values)
-            if op == OP_MIN:
-                return min(values)
-            if op == OP_WAVG_QPS and sum(weights) > 0:
-                total = sum(weights)
-                return sum(v * w for v, w in zip(values, weights)) / total
-            return sum(values) / len(values)
+            return merge_values(op, values, weights)
         return read
 
     # ------------------------------------------------------------- views

@@ -62,7 +62,7 @@ class Span:
                  "start_mono_us", "end_mono_us",
                  "error_code", "request_size", "response_size",
                  "annotations", "phases", "events", "events_dropped",
-                 "_ended")
+                 "retained_reason", "_ended")
 
     def __init__(self, trace_id: int, span_id: int, parent_span_id: int,
                  kind: str, service: str = "", method: str = "",
@@ -87,6 +87,10 @@ class Span:
         self.phases: Dict[str, float] = {}
         self.events: List = []  # (offset_us from start, name, fields dict)
         self.events_dropped = 0
+        # non-empty once tail retention committed this span to rpc_dump
+        # ("slow_p99" / "error" / "qos_shed" / "watch:<rule>") — the
+        # /rpcz?retained=tail filter key
+        self.retained_reason = ""
         self._ended = False
 
     # ------------------------------------------------------------ lifecycle
@@ -151,6 +155,8 @@ class Span:
         }
         if self.events_dropped:
             d["events_dropped"] = self.events_dropped
+        if self.retained_reason:
+            d["retained_reason"] = self.retained_reason
         return d
 
     # ------------------------------------------------------------ rendering
@@ -256,11 +262,13 @@ def _db_add(span: Span) -> None:
 
 def recent_spans(count: int = 50, method: str = "",
                  min_latency_us: float = 0.0,
-                 error_only: bool = False) -> List[Span]:
+                 error_only: bool = False,
+                 retained: str = "") -> List[Span]:
     """Newest-first finished spans, optionally filtered (the /rpcz query
     surface): ``method`` is a substring match against service.method,
     ``min_latency_us`` keeps only slower spans, ``error_only`` keeps only
-    spans with a non-zero error code."""
+    spans with a non-zero error code, ``retained="tail"`` keeps only spans
+    committed to rpc_dump by tail retention (any reason)."""
     with _db_lock:
         spans = list(_db)
     out: List[Span] = []
@@ -270,6 +278,8 @@ def recent_spans(count: int = 50, method: str = "",
         if min_latency_us and sp.latency_us < min_latency_us:
             continue
         if error_only and not sp.error_code:
+            continue
+        if retained and not sp.retained_reason:
             continue
         out.append(sp)
         if len(out) >= count:

@@ -19,7 +19,7 @@ EXPECTED_RULES = {
     "named-thread", "cross-process-ownership", "metric-churn",
     "no-per-token-host-sync", "no-per-op-step-dispatch",
     "cow-before-write", "quiesce-before-migrate",
-    "draft-no-device-sync", "shed-before-queue",
+    "draft-no-device-sync", "shed-before-queue", "budget-gated-scrape",
 }
 
 
@@ -500,6 +500,95 @@ class TestNamedThread:
                 # tpulint: disable=named-thread
                 threading.Thread(target=self._run).start()
             """}, rules=["named-thread"])
+        assert res.clean and len(res.suppressed) == 1
+
+
+# ------------------------------------------------- budget-gated-scrape
+class TestBudgetGatedScrape:
+    RULE = ["budget-gated-scrape"]
+
+    def test_unbudgeted_sleep_loop_fires(self, tmp_path):
+        res = _lint(tmp_path, {"fleet/observer.py": """\
+            import time
+            def run(self):
+                while not self._stop.is_set():
+                    self.scrape_once()
+                    time.sleep(2.0)
+            """}, rules=self.RULE)
+        assert [f.rule for f in res.findings] == ["budget-gated-scrape"]
+        assert res.findings[0].line == 3
+        assert "ask_to_be_sampled" in res.findings[0].message
+        assert "flags.get" in res.findings[0].message
+
+    def test_flag_read_without_budget_still_fires(self, tmp_path):
+        res = _lint(tmp_path, {"fleet/observer.py": """\
+            def run(self):
+                while not self._stop.is_set():
+                    self.scrape_once()
+                    self._stop.wait(_flags.get("fleet_scrape_interval_s"))
+            """}, rules=self.RULE)
+        assert len(res.findings) == 1
+        assert "ask_to_be_sampled" in res.findings[0].message
+        assert "flags.get" not in res.findings[0].message
+
+    def test_budget_without_flag_still_fires(self, tmp_path):
+        res = _lint(tmp_path, {"fleet/observer.py": """\
+            def run(self):
+                while not self._stop.is_set():
+                    if global_collector().ask_to_be_sampled():
+                        self.scrape_once()
+                    self._stop.wait(2.0)
+            """}, rules=self.RULE)
+        assert len(res.findings) == 1
+        assert "flags.get" in res.findings[0].message
+
+    def test_both_legs_pass(self, tmp_path):
+        # the canonical observer loop: reloadable interval + budget draw
+        res = _lint(tmp_path, {"fleet/observer.py": """\
+            def run(self):
+                while not self._stop.is_set():
+                    if global_collector().ask_to_be_sampled():
+                        self.scrape_once()
+                    self._stop.wait(_flags.get("fleet_scrape_interval_s"))
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_wait_in_loop_condition_counts_as_periodic(self, tmp_path):
+        res = _lint(tmp_path, {"fleet/poller.py": """\
+            def run(self):
+                while not self._stop.wait(1.0):
+                    self.scrape_once()
+            """}, rules=self.RULE)
+        assert not res.clean
+
+    def test_non_periodic_fleet_code_passes(self, tmp_path):
+        res = _lint(tmp_path, {"fleet/merge.py": """\
+            def merge(values):
+                total = 0.0
+                for v in values:
+                    total += v
+                return total
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_same_loop_outside_fleet_scope_passes(self, tmp_path):
+        res = _lint(tmp_path, {"shard/worker.py": """\
+            import time
+            def run(self):
+                while True:
+                    self.pump()
+                    time.sleep(0.5)
+            """}, rules=self.RULE)
+        assert res.clean
+
+    def test_suppression_comment(self, tmp_path):
+        res = _lint(tmp_path, {"fleet/observer.py": """\
+            import time
+            def run(self):
+                while True:  # tpulint: disable=budget-gated-scrape
+                    self.scrape_once()
+                    time.sleep(2.0)
+            """}, rules=self.RULE)
         assert res.clean and len(res.suppressed) == 1
 
 

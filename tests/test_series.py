@@ -562,6 +562,28 @@ class TestPrometheusRoundTrip:
         finally:
             fv.hide_all()
 
+    def test_cluster_vars_round_trip_with_merge_help(self):
+        from brpc_tpu.fleet import FleetObserver
+
+        def fetch(addr, path):
+            if path != "/vars?series=json":
+                return {"engines": [], "rules": []}
+            n = 2 if addr == "a:1" else 3
+            return {"workers": 0, "series": {},
+                    "vars": {"g_cluster_rt": ["sum", "counter", n]}}
+
+        obs = FleetObserver("list://a:1,b:2", fetch=fetch)
+        try:
+            assert obs.scrape_once() == 2
+            types, helps, samples = _parse_exposition(prometheus_text())
+            assert types["cluster_g_cluster_rt"] == "counter"
+            assert "sum" in helps["cluster_g_cluster_rt"]
+            assert samples["cluster_g_cluster_rt"] == 5.0
+            assert types["cluster_fleet_members_live"] == "gauge"
+            assert samples["cluster_fleet_members_live"] == 2.0
+        finally:
+            obs.hide_all()
+
 
 # ------------------------------------------------------- vars_view smoke
 class TestVarsViewTool:
@@ -598,6 +620,39 @@ class TestVarsViewTool:
         vars_view = importlib.import_module("tools.vars_view")
         assert "no vars match" in vars_view.render({"series": {}}, "*",
                                                    "second")
+
+    def test_render_fleet_merges_op_correctly(self):
+        import importlib
+
+        vars_view = importlib.import_module("tools.vars_view")
+
+        def member_doc(values, op="sum", ptype="counter"):
+            s = VarSeries()
+            for v in values:
+                s.append(v)
+            return {"series": {"g_reqs": s.to_dict()},
+                    "vars": {"g_reqs": [op, ptype, values[-1]]}}
+
+        docs = {"hosta:1": member_doc([1, 2, 3]),
+                "hostb:2": member_doc([10, 20, 30])}
+        out = vars_view.render_fleet(docs, "g_reqs", "second")
+        assert "hosta:1" in out and "hostb:2" in out
+        assert "[sum]" in out
+        # merged row: element-wise sum, so last = 3 + 30
+        assert "=merged" in out
+        assert "last=33" in out
+
+    def test_render_fleet_max_op(self):
+        import importlib
+
+        vars_view = importlib.import_module("tools.vars_view")
+        mk = lambda v: {"series": {"p99": dict(VarSeries().to_dict(),
+                                               second=[v], last=v)},
+                        "vars": {"p99": ["max", "gauge", v]}}
+        out = vars_view.render_fleet({"a:1": mk(900.0), "b:2": mk(100.0)},
+                                     "p99", "second")
+        assert "[max]" in out
+        assert "last=900" in out
 
 
 # ----------------------------------------------------------- workers=2 e2e
